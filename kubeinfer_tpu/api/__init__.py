@@ -1,0 +1,27 @@
+"""Resource API types (parity: reference api/v1/)."""
+
+from kubeinfer_tpu.api.types import (
+    CacheStrategy,
+    Condition,
+    LLMService,
+    LLMServiceList,
+    LLMServiceSpec,
+    LLMServiceStatus,
+    ObjectMeta,
+    SchedulerPolicy,
+    ValidationError,
+    parse_quantity,
+)
+
+__all__ = [
+    "CacheStrategy",
+    "Condition",
+    "LLMService",
+    "LLMServiceList",
+    "LLMServiceSpec",
+    "LLMServiceStatus",
+    "ObjectMeta",
+    "SchedulerPolicy",
+    "ValidationError",
+    "parse_quantity",
+]

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: clock abstraction, logging, env config."""
+
+from kubeinfer_tpu.utils.clock import Clock, RealClock, SimulatedClock
+
+__all__ = ["Clock", "RealClock", "SimulatedClock"]

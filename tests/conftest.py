@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run on
+8 virtual CPU devices (the same code path pjit/shard_map take on a real TPU
+mesh — only the device kind differs). Must run before any test module
+imports jax.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,133 @@
+"""API type tests: defaults, validation, round-tripping.
+
+Model: the reference CRD schema (config/crd/bases/ai.ruijie.io_llmservices.yaml:45-60)
+and the table-driven env tests in internal/agent/config/config_test.go:9-124.
+"""
+
+import pytest
+
+from kubeinfer_tpu.api import (
+    CacheStrategy,
+    LLMService,
+    LLMServiceSpec,
+    SchedulerPolicy,
+    ValidationError,
+    parse_quantity,
+)
+from kubeinfer_tpu.api.types import DEFAULT_IMAGE, Condition, LLMServiceStatus, ObjectMeta
+from kubeinfer_tpu.api.workload import NodeState, ReplicaSpec, Workload
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,expect",
+        [("24Gi", 24 * 1024**3), ("512Mi", 512 * 1024**2), ("1Gi", 1024**3)],
+    )
+    def test_valid(self, s, expect):
+        assert parse_quantity(s) == expect
+
+    @pytest.mark.parametrize("s", ["24G", "24", "Gi", "1.5Gi", "-1Gi", "24Ki", ""])
+    def test_invalid(self, s):
+        with pytest.raises(ValidationError):
+            parse_quantity(s)
+
+
+class TestSpecValidation:
+    def test_defaults(self):
+        spec = LLMServiceSpec(model="deepseek-ai/deepseek-r1")
+        spec.validate()
+        assert spec.replicas == 1
+        assert spec.gpu_per_replica == 0
+        assert spec.cache_strategy == CacheStrategy.NONE
+        assert spec.image == DEFAULT_IMAGE
+        assert spec.scheduler_policy == SchedulerPolicy.JAX_GREEDY
+
+    def test_model_required(self):
+        with pytest.raises(ValidationError, match="model"):
+            LLMServiceSpec().validate()
+
+    def test_replicas_min(self):
+        with pytest.raises(ValidationError, match="replicas"):
+            LLMServiceSpec(model="m", replicas=0).validate()
+
+    def test_gpu_min(self):
+        with pytest.raises(ValidationError, match="gpuPerReplica"):
+            LLMServiceSpec(model="m", gpu_per_replica=-1).validate()
+
+    def test_bad_gpu_memory(self):
+        with pytest.raises(ValidationError, match="gpuMemory"):
+            LLMServiceSpec(model="m", gpu_memory="24G").validate()
+
+    def test_bad_cache_strategy_via_dict(self):
+        with pytest.raises(ValidationError, match="cacheStrategy"):
+            LLMServiceSpec.from_dict({"model": "m", "cacheStrategy": "weird"})
+
+    def test_bad_policy_via_dict(self):
+        with pytest.raises(ValidationError, match="schedulerPolicy"):
+            LLMServiceSpec.from_dict({"model": "m", "schedulerPolicy": "quantum"})
+
+    def test_gpu_memory_bytes(self):
+        assert LLMServiceSpec(model="m", gpu_memory="24Gi").gpu_memory_bytes() == 24 * 1024**3
+        assert LLMServiceSpec(model="m").gpu_memory_bytes() == 0
+
+
+class TestRoundTrip:
+    def test_llmservice(self):
+        svc = LLMService(
+            metadata=ObjectMeta(name="svc-a", namespace="prod", labels={"team": "ml"}),
+            spec=LLMServiceSpec(
+                model="meta-llama/Llama-3-8b",
+                replicas=3,
+                gpu_per_replica=2,
+                cache_strategy=CacheStrategy.SHARED,
+                gpu_memory="24Gi",
+                scheduler_policy=SchedulerPolicy.JAX_AUCTION,
+                priority=5,
+                gang=True,
+            ),
+        )
+        svc.status.set_condition(Condition(type="Scheduled", status="True", reason="Solved"))
+        svc.status.placements = ["node-1", "node-2", "node-3"]
+        svc.validate()
+        back = LLMService.from_dict(svc.to_dict())
+        assert back.to_dict() == svc.to_dict()
+        assert back.spec.cache_strategy is CacheStrategy.SHARED
+        assert back.status.get_condition("Scheduled").reason == "Solved"
+
+    def test_condition_replace(self):
+        st = LLMServiceStatus()
+        st.set_condition(Condition(type="Ready", status="False"))
+        st.set_condition(Condition(type="Ready", status="True"))
+        assert len(st.conditions) == 1
+        assert st.conditions[0].status == "True"
+
+    def test_workload(self):
+        w = Workload(
+            metadata=ObjectMeta(name="svc-a-workload"),
+            owner="svc-a",
+            image="vllm/vllm-openai:latest",
+            model_repo="meta-llama/Llama-3-8b",
+            cache_group="svc-a-cache",
+            cache_shared=True,
+            gpu_per_replica=2,
+            replicas=[ReplicaSpec(index=0, node="node-1"), ReplicaSpec(index=1)],
+            env={"MODEL_REPO": "meta-llama/Llama-3-8b"},
+        )
+        back = Workload.from_dict(w.to_dict())
+        assert back.to_dict() == w.to_dict()
+        assert back.replicas[0].node == "node-1"
+        assert back.replicas[1].phase == "Pending"
+
+    def test_node(self):
+        n = NodeState(
+            metadata=ObjectMeta(name="node-1"),
+            gpu_capacity=8,
+            gpu_free=6.5,
+            gpu_memory_bytes=80 * 1024**3,
+            topology=(2, 0),
+            cached_models=["m1"],
+            ip="10.0.0.5",
+        )
+        back = NodeState.from_dict(n.to_dict())
+        assert back.to_dict() == n.to_dict()
+        assert back.topology == (2, 0)
