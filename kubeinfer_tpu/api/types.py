@@ -136,7 +136,9 @@ class ObjectMeta:
             labels=dict(d.get("labels") or {}),
             annotations=dict(d.get("annotations") or {}),
             owner_references=[dict(r) for r in (d.get("ownerReferences") or [])],
-            creation_timestamp=float(d.get("creationTimestamp", 0.0)),
+            creation_timestamp=_coerce_float(
+                d.get("creationTimestamp", 0.0), "metadata.creationTimestamp"
+            ),
             deletion_timestamp=d.get("deletionTimestamp"),
         )
 
@@ -267,7 +269,9 @@ class Condition:
             status=d.get("status", "Unknown"),
             reason=d.get("reason", ""),
             message=d.get("message", ""),
-            last_update_time=float(d.get("lastUpdateTime", 0.0)),
+            last_update_time=_coerce_float(
+                d.get("lastUpdateTime", 0.0), "condition.lastUpdateTime"
+            ),
         )
 
 

@@ -1,0 +1,43 @@
+"""The accelerated scheduling core.
+
+This is the component the reference lacks entirely: it declares
+scheduling-relevant CRD fields (gpuPerReplica, gpuMemory, cacheStrategy;
+reference api/v1/llmservice_types.go:38-51) but never reads them — placement
+is delegated to kube-scheduler via a Deployment
+(internal/controller/llmservice_controller.go:193-312). Here, every reconcile
+tick batches ALL pending replicas and ALL node-state vectors into one dense
+jobs x nodes problem and solves feasibility-masked scoring + assignment on
+TPU under ``jax.jit`` (BASELINE.json north star).
+"""
+
+from kubeinfer_tpu.solver.problem import (
+    BUCKETS,
+    JobSet,
+    NodeSet,
+    Problem,
+    bucket_size,
+    encode_problem,
+)
+from kubeinfer_tpu.solver.core import (
+    INFEASIBLE,
+    Assignment,
+    ScoreWeights,
+    solve,
+    solve_auction,
+    solve_greedy,
+)
+
+__all__ = [
+    "BUCKETS",
+    "INFEASIBLE",
+    "Assignment",
+    "JobSet",
+    "NodeSet",
+    "Problem",
+    "ScoreWeights",
+    "bucket_size",
+    "encode_problem",
+    "solve",
+    "solve_auction",
+    "solve_greedy",
+]

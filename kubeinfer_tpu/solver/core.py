@@ -1,0 +1,385 @@
+"""Batched assignment solvers under ``jax.jit``.
+
+Two device-side algorithms, selected per job via ``schedulerPolicy``:
+
+``solve_greedy`` — parallel greedy with per-node conflict resolution.
+  Each round, every unplaced replica bids on its argmin-cost feasible node
+  ([J, N] masked reduction); contested nodes accept bidders in
+  (priority desc, cost asc) order up to remaining capacity via a sorted
+  segmented prefix-scan; capacities update and the loop repeats under
+  ``lax.while_loop`` until a fixpoint or round budget. At a fixpoint every
+  still-unplaced job provably had no feasible node left. This is the
+  TPU-shaped replacement for a serial first-fit loop: rounds are O(J*N)
+  dense vector ops (VPU/HBM-friendly) instead of 10k sequential decisions.
+
+``solve_auction`` — Bertsekas-style auction for one-replica-per-node
+  instances (whole-node requests), giving Hungarian-quality assignments
+  with bounded suboptimality J*eps. Dense bid matrix per iteration; pick it
+  when quality beats cost (BASELINE.json config 3's "Hungarian" tier).
+
+Design notes (SURVEY.md §7 hard parts 1-4):
+- Everything is static-shape; no data-dependent Python control flow.
+- Priority + preemption fall out of full re-solves: incumbents re-bid with a
+  hysteresis (move-penalty) cost term, so placements are stable unless a
+  higher-priority bidder genuinely needs the capacity.
+- Gang all-or-nothing is a post-solve repair: incompletely-placed gangs are
+  unwound and their capacity returned (one segmented reduction).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubeinfer_tpu.solver.problem import Problem
+
+INFEASIBLE = jnp.float32(1e9)
+_EPS = 1e-4  # capacity comparison slack for f32 fractional demands
+
+
+@dataclass(frozen=True)
+class ScoreWeights:
+    """Cost-matrix weights. Lower cost = better placement.
+
+    ``fit_gpu``/``fit_mem`` implement best-fit pressure: leftover capacity
+    (normalized by node capacity, so each term is bounded in [0, 1]) is
+    cost — tight fits win and fragmentation stays low, but no node is ever
+    more than ~1.5 cost away from another on fit alone, which keeps the
+    tie-spreading noise effective (see ``noise``).
+    ``cache`` discounts nodes that already hold the replica's model (the
+    whole point of the reference's shared-cache plane). ``move`` is the
+    hysteresis penalty keeping re-solves from thrashing incumbents.
+    ``topology`` penalizes leaving the replica's preferred topology group.
+    """
+
+    fit_gpu: float = 1.0
+    fit_mem: float = 0.5
+    cache: float = 5.0
+    move: float = 8.0
+    topology: float = 2.0
+    # Tie-spreading temperature: deterministic Gumbel perturbation added to
+    # the greedy cost matrix. Identical jobs see identical costs, so without
+    # it the whole fleet bids the same argmin node every round and per-round
+    # acceptance collapses to one node's capacity. Noise ~0.3 spreads bids
+    # across near-tied nodes while leaving real cost gaps (cache hit = 5.0,
+    # move = 8.0) intact: P(flip) < 1e-7.
+    noise: float = 0.3
+
+
+jax.tree_util.register_dataclass(
+    ScoreWeights,
+    data_fields=[],
+    meta_fields=["fit_gpu", "fit_mem", "cache", "move", "topology", "noise"],
+)
+
+
+@dataclass
+class Assignment:
+    """Solver output: per-job node index (-1 = unplaced) + diagnostics."""
+
+    node: jax.Array  # i32[J]
+    gpu_free: jax.Array  # f32[N] capacity remaining after placement
+    mem_free: jax.Array  # f32[N]
+    rounds: jax.Array  # i32 rounds/iterations used
+    placed: jax.Array  # i32 number of placed (valid) jobs
+
+
+jax.tree_util.register_dataclass(
+    Assignment,
+    data_fields=["node", "gpu_free", "mem_free", "rounds", "placed"],
+    meta_fields=[],
+)
+
+
+def _static_cost(p: Problem, w: ScoreWeights) -> jax.Array:
+    """[J, N] cost terms that don't depend on remaining capacity."""
+    jobs, nodes = p.jobs, p.nodes
+    # cache affinity: cached[n, model_id[j]] -> [J, N]
+    hit = jnp.take(nodes.cached, jobs.model_id, axis=1).T  # [J, N] bool
+    cost = w.cache * (1.0 - hit.astype(jnp.float32))
+
+    n_idx = jnp.arange(nodes.valid.shape[0], dtype=jnp.int32)
+    has_home = jobs.current_node >= 0
+    moved = has_home[:, None] & (jobs.current_node[:, None] != n_idx[None, :])
+    cost = cost + w.move * moved.astype(jnp.float32)
+
+    # preferred topology group = incumbent node's group (when placed)
+    home = jnp.clip(jobs.current_node, 0, nodes.valid.shape[0] - 1)
+    pref = jnp.where(has_home, nodes.topology[home], -1)
+    topo_miss = (pref[:, None] >= 0) & (pref[:, None] != nodes.topology[None, :])
+    cost = cost + w.topology * topo_miss.astype(jnp.float32)
+    return cost
+
+
+def _segmented_accept(
+    choice: jax.Array,  # i32[J], node index or N (= no bid sentinel)
+    bid_cost: jax.Array,  # f32[J] cost of the chosen node
+    gpu_demand: jax.Array,
+    mem_demand: jax.Array,
+    priority: jax.Array,
+    gpu_free: jax.Array,  # f32[N]
+    mem_free: jax.Array,
+    num_nodes: int,
+) -> jax.Array:
+    """Resolve per-node conflicts: accept bidders in (priority desc, demand
+    asc, cost asc) order while the node's remaining capacity holds. Returns
+    bool[J] accept mask (in original job order).
+
+    Vectorized as: stable sort by the acceptance key; segmented prefix-sums
+    of demand per node; a bidder is accepted iff every bidder at or before
+    it in its segment fits (prefix-closed greedy). Demand-ascending within a
+    priority class stops one oversized bidder from blocking a node's whole
+    round.
+    """
+    J = choice.shape[0]
+    order = jnp.lexsort((bid_cost, gpu_demand, -priority, choice))
+    s_choice = choice[order]
+    bidding = s_choice < num_nodes
+    s_gpu = jnp.where(bidding, gpu_demand[order], 0.0)
+    s_mem = jnp.where(bidding, mem_demand[order], 0.0)
+
+    cum_gpu = jnp.cumsum(s_gpu)
+    cum_mem = jnp.cumsum(s_mem)
+    k = jnp.arange(J, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s_choice[1:] != s_choice[:-1]]
+    )
+    seg_start = lax.cummax(jnp.where(is_start, k, -1))
+    base_gpu = (cum_gpu - s_gpu)[seg_start]
+    base_mem = (cum_mem - s_mem)[seg_start]
+    within_gpu = cum_gpu - base_gpu
+    within_mem = cum_mem - base_mem
+
+    node_of = jnp.clip(s_choice, 0, num_nodes - 1)
+    fit = (
+        bidding
+        & (within_gpu <= gpu_free[node_of] + _EPS)
+        & (within_mem <= mem_free[node_of] + _EPS)
+    )
+    last_bad = lax.cummax(jnp.where(~fit, k, -1))
+    s_accept = fit & (last_bad < seg_start)
+
+    accept = jnp.zeros((J,), bool).at[order].set(s_accept)
+    return accept
+
+
+@functools.partial(jax.jit, static_argnames=("max_rounds",))
+def solve_greedy(
+    p: Problem,
+    weights: ScoreWeights = ScoreWeights(),
+    max_rounds: int = 32,
+) -> Assignment:
+    """Parallel greedy with conflict resolution (policy ``jax-greedy``)."""
+    jobs, nodes = p.jobs, p.nodes
+    J = jobs.valid.shape[0]
+    N = nodes.valid.shape[0]
+    static_cost = _static_cost(p, weights)
+    node_valid_row = nodes.valid[None, :]
+    inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
+    inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
+
+    def cond(state):
+        assigned, gpu_free, mem_free, rounds, progress = state
+        pending = jnp.any((assigned < 0) & jobs.valid)
+        return progress & pending & (rounds < max_rounds)
+
+    def body(state):
+        assigned, gpu_free, mem_free, rounds, _ = state
+        unassigned = (assigned < 0) & jobs.valid
+        feas = (
+            (jobs.gpu_demand[:, None] <= gpu_free[None, :] + _EPS)
+            & (jobs.mem_demand[:, None] <= mem_free[None, :] + _EPS)
+            & node_valid_row
+            & unassigned[:, None]
+        )
+        fit_cost = weights.fit_gpu * (
+            (gpu_free[None, :] - jobs.gpu_demand[:, None]) * inv_gpu_cap[None, :]
+        )
+        fit_cost = fit_cost + weights.fit_mem * (
+            (mem_free[None, :] - jobs.mem_demand[:, None]) * inv_mem_cap[None, :]
+        )
+        # Fresh tie-spreading field each round (deterministic in the round
+        # index) so repeated conflicts between the same bidders decorrelate.
+        tie_noise = weights.noise * jax.random.gumbel(
+            jax.random.fold_in(jax.random.PRNGKey(0), rounds), (J, N), jnp.float32
+        )
+        cost = jnp.where(feas, static_cost + fit_cost + tie_noise, INFEASIBLE)
+
+        best_cost = jnp.min(cost, axis=1)
+        choice = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        has_bid = best_cost < INFEASIBLE * 0.5
+        choice = jnp.where(has_bid, choice, N)
+
+        accept = _segmented_accept(
+            choice, best_cost, jobs.gpu_demand, jobs.mem_demand,
+            jobs.priority, gpu_free, mem_free, N,
+        )
+        assigned = jnp.where(accept, choice, assigned)
+        used_gpu = jax.ops.segment_sum(
+            jnp.where(accept, jobs.gpu_demand, 0.0), choice, num_segments=N + 1
+        )[:N]
+        used_mem = jax.ops.segment_sum(
+            jnp.where(accept, jobs.mem_demand, 0.0), choice, num_segments=N + 1
+        )[:N]
+        return (
+            assigned,
+            gpu_free - used_gpu,
+            mem_free - used_mem,
+            rounds + 1,
+            jnp.any(accept),
+        )
+
+    init = (
+        jnp.full((J,), -1, jnp.int32),
+        nodes.gpu_free,
+        nodes.mem_free,
+        jnp.int32(0),
+        jnp.bool_(True),
+    )
+    assigned, gpu_free, mem_free, rounds, _ = lax.while_loop(cond, body, init)
+
+    assigned, gpu_free, mem_free = _gang_repair(p, assigned)
+    placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
+    return Assignment(assigned, gpu_free, mem_free, rounds, placed)
+
+
+def _gang_repair(p: Problem, assigned: jax.Array):
+    """Unwind incompletely-placed gangs (all-or-nothing) and recompute
+    capacity from scratch. Gang ids must lie in [0, J)."""
+    jobs, nodes = p.jobs, p.nodes
+    J = jobs.valid.shape[0]
+    N = nodes.valid.shape[0]
+    in_gang = (jobs.gang_id >= 0) & jobs.valid
+    gid = jnp.clip(jobs.gang_id, 0, J - 1)
+    need = jax.ops.segment_sum(in_gang.astype(jnp.int32), gid, num_segments=J)
+    got = jax.ops.segment_sum(
+        (in_gang & (assigned >= 0)).astype(jnp.int32), gid, num_segments=J
+    )
+    complete = got == need
+    keep = (~in_gang) | complete[gid]
+    assigned = jnp.where(keep, assigned, -1)
+
+    seg = jnp.where(assigned >= 0, assigned, N)
+    used_gpu = jax.ops.segment_sum(
+        jnp.where(assigned >= 0, jobs.gpu_demand, 0.0), seg, num_segments=N + 1
+    )[:N]
+    used_mem = jax.ops.segment_sum(
+        jnp.where(assigned >= 0, jobs.mem_demand, 0.0), seg, num_segments=N + 1
+    )[:N]
+    return assigned, nodes.gpu_free - used_gpu, nodes.mem_free - used_mem
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def solve_auction(
+    p: Problem,
+    weights: ScoreWeights = ScoreWeights(),
+    eps: float = 0.01,
+    max_iters: int = 512,
+) -> Assignment:
+    """Auction assignment (policy ``jax-auction``): one replica per node.
+
+    Feasible means the whole remaining node capacity satisfies the demand;
+    each node hosts at most one replica. Within-eps-optimal total cost for
+    the jobs it places (standard auction guarantee: J*eps of optimal).
+    """
+    jobs, nodes = p.jobs, p.nodes
+    J = jobs.valid.shape[0]
+    N = nodes.valid.shape[0]
+    static_cost = _static_cost(p, weights)
+    feas = (
+        (jobs.gpu_demand[:, None] <= nodes.gpu_free[None, :] + _EPS)
+        & (jobs.mem_demand[:, None] <= nodes.mem_free[None, :] + _EPS)
+        & nodes.valid[None, :]
+        & jobs.valid[:, None]
+    )
+    # benefit: higher is better; strictly bounded so -INF marks infeasible
+    inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
+    inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
+    fit_cost = weights.fit_gpu * (
+        (nodes.gpu_free[None, :] - jobs.gpu_demand[:, None]) * inv_gpu_cap[None, :]
+    )
+    fit_cost = fit_cost + weights.fit_mem * (
+        (nodes.mem_free[None, :] - jobs.mem_demand[:, None]) * inv_mem_cap[None, :]
+    )
+    benefit = jnp.where(feas, -(static_cost + fit_cost), -INFEASIBLE)
+    NEG = -INFEASIBLE
+
+    def cond(state):
+        assigned, owner, prices, it, progress = state
+        pending = jnp.any((assigned < 0) & jobs.valid)
+        return progress & pending & (it < max_iters)
+
+    def body(state):
+        assigned, owner, prices, it, _ = state
+        unassigned = (assigned < 0) & jobs.valid
+        value = jnp.where(unassigned[:, None], benefit - prices[None, :], NEG)
+        top2, top2_idx = lax.top_k(value, 2)
+        best_v, second_v = top2[:, 0], top2[:, 1]
+        best_n = top2_idx[:, 0].astype(jnp.int32)
+        can_bid = unassigned & (best_v > NEG * 0.5)
+        # classic bid: price rise = value margin + eps
+        bid = jnp.where(can_bid, prices[best_n] + (best_v - second_v) + eps, NEG)
+
+        # per-node highest bid wins; ties broken by lowest job index
+        bid_matrix = jnp.full((J, N), NEG, jnp.float32)
+        j_idx = jnp.arange(J, dtype=jnp.int32)
+        bid_matrix = bid_matrix.at[j_idx, jnp.clip(best_n, 0, N - 1)].set(
+            jnp.where(can_bid, bid, NEG)
+        )
+        win_bid = jnp.max(bid_matrix, axis=0)
+        winner = jnp.argmax(bid_matrix, axis=0).astype(jnp.int32)
+        node_has_winner = win_bid > NEG * 0.5
+
+        # Evict previous owners of re-won nodes. Non-events are routed to a
+        # sentinel slot J so scatters never collide on a clipped index 0.
+        evicted_owner = jnp.where(node_has_winner, owner, -1)
+        evict_idx = jnp.where(evicted_owner >= 0, evicted_owner, J)
+        evict_mask = jnp.zeros((J + 1,), bool).at[evict_idx].set(True)[:J]
+        assigned = jnp.where(evict_mask, -1, assigned)
+
+        owner = jnp.where(node_has_winner, winner, owner)
+        prices = jnp.where(node_has_winner, win_bid, prices)
+        # Each job bids on exactly one node, so winners are distinct jobs;
+        # sentinel routing keeps no-winner nodes from clobbering job 0.
+        win_idx = jnp.where(node_has_winner, winner, J)
+        won_node = (
+            jnp.full((J + 1,), -1, jnp.int32)
+            .at[win_idx]
+            .set(jnp.arange(N, dtype=jnp.int32))[:J]
+        )
+        assigned = jnp.where(won_node >= 0, won_node, assigned)
+        return (assigned, owner, prices, it + 1, jnp.any(can_bid))
+
+    init = (
+        jnp.full((J,), -1, jnp.int32),
+        jnp.full((N,), -1, jnp.int32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.int32(0),
+        jnp.bool_(True),
+    )
+    assigned, owner, prices, iters, _ = lax.while_loop(cond, body, init)
+
+    assigned, gpu_free, mem_free = _gang_repair(p, assigned)
+    placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
+    return Assignment(assigned, gpu_free, mem_free, iters, placed)
+
+
+def solve(p: Problem, policy: str = "jax-greedy", weights: ScoreWeights = ScoreWeights()) -> Assignment:
+    """Dispatch by schedulerPolicy value (JAX policies only).
+
+    ``native-greedy`` is the serial C++ baseline owned by the controller's
+    backend layer, not this module — routing it here would silently run the
+    wrong scorer, so it's rejected loudly, as is any unknown policy.
+    """
+    if policy == "jax-auction":
+        return solve_auction(p, weights)
+    if policy == "jax-greedy":
+        return solve_greedy(p, weights)
+    raise ValueError(
+        f"unknown JAX solver policy {policy!r}; 'native-greedy' is dispatched "
+        "by the controller's SchedulerBackend layer, not the JAX solver"
+    )

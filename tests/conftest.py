@@ -3,13 +3,15 @@
 Multi-chip TPU hardware is not available in CI; all sharding tests run on
 8 virtual CPU devices (the same code path pjit/shard_map take on a real TPU
 mesh — only the device kind differs). Must run before any test module
-imports jax.
+imports jax. Explicit assignment (not setdefault): this machine exports
+JAX_PLATFORMS=axon globally, and tests must not run on the experimental
+single-chip tunnel backend.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
