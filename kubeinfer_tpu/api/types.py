@@ -312,7 +312,9 @@ class LLMServiceStatus:
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "LLMServiceStatus":
         return cls(
-            available_replicas=int(d.get("availableReplicas", 0)),
+            available_replicas=_coerce_int(
+                d.get("availableReplicas", 0), "status.availableReplicas"
+            ),
             conditions=[Condition.from_dict(c) for c in (d.get("conditions") or [])],
             cache_coordinator=d.get("cacheCoordinator", ""),
             placements=list(d.get("placements") or []),

@@ -1,0 +1,244 @@
+"""Versioned, watchable object store — the API-server equivalent.
+
+Semantics held to (what the reference code depends on in the real API server):
+
+- **Create is atomic**: two racing creates of the same key — the exact race
+  the reference's lease election leans on ("race-safe: create conflicts
+  fail", election.go:72-104) — yield exactly one winner; the loser gets
+  ``AlreadyExistsError``.
+- **Update is optimistic CAS**: an update must carry the resourceVersion it
+  read; a stale version raises ``ConflictError`` (election.go:133-134 relies
+  on this for lease stealing).
+- **Watches** deliver ordered ADDED/MODIFIED/DELETED events per key after the
+  subscription point; the controller's reconcile triggering
+  (SetupWithManager/Owns, llmservice_controller.go:316-321) is built on this.
+
+Objects are stored and returned as plain dicts (the typed API's
+``to_dict``/``from_dict`` round-trip) and deep-copied at the boundary, so no
+caller can mutate the store's truth in place — the same isolation a real API
+server's serialization boundary provides.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+
+class NotFoundError(KeyError):
+    """Object does not exist (the IsNotFound branch, llmservice_controller.go:90)."""
+
+
+class AlreadyExistsError(ValueError):
+    """Create raced with an existing object (lease-creation race, election.go:95-103)."""
+
+
+class ConflictError(ValueError):
+    """Optimistic-concurrency failure: stale resourceVersion (election.go:133-134)."""
+
+
+@dataclass(frozen=True)
+class Key:
+    kind: str
+    namespace: str
+    name: str
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    namespace: str
+    name: str
+    object: dict[str, Any]
+    resource_version: int
+
+
+@dataclass
+class _Watcher:
+    q: "queue.Queue[WatchEvent]"
+    kind: str | None
+    namespace: str | None
+    closed: threading.Event = field(default_factory=threading.Event)
+
+
+class Store:
+    """Thread-safe versioned object store with watch streams."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._objects: dict[Key, dict[str, Any]] = {}
+        self._rv = 0
+        self._watchers: list[_Watcher] = []
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _meta(obj: dict[str, Any]) -> dict[str, Any]:
+        return obj.setdefault("metadata", {})
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for w in self._watchers:
+            if w.closed.is_set():
+                continue
+            if w.kind is not None and w.kind != ev.kind:
+                continue
+            if w.namespace is not None and w.namespace != ev.namespace:
+                continue
+            # Each watcher gets its own object copy: consumers may normalize
+            # events in place and must not see each other's mutations.
+            w.q.put(
+                WatchEvent(
+                    ev.type, ev.kind, ev.namespace, ev.name,
+                    copy.deepcopy(ev.object), ev.resource_version,
+                )
+            )
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        meta = self._meta(obj)
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "default")
+        if not name:
+            raise ValueError("metadata.name is required")
+        key = Key(kind, namespace, name)
+        with self._lock:
+            if key in self._objects:
+                raise AlreadyExistsError(f"{kind} {namespace}/{name} already exists")
+            rv = self._next_rv()
+            meta["namespace"] = namespace
+            meta["resourceVersion"] = rv
+            meta.setdefault("generation", 1)
+            self._objects[key] = obj
+            self._notify(WatchEvent("ADDED", kind, namespace, name, copy.deepcopy(obj), rv))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
+        key = Key(kind, namespace, name)
+        with self._lock:
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        """CAS update: obj.metadata.resourceVersion must match the stored one."""
+        obj = copy.deepcopy(obj)
+        meta = self._meta(obj)
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "default")
+        key = Key(kind, namespace, name)
+        with self._lock:
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            meta["namespace"] = namespace
+            expected = current["metadata"].get("resourceVersion", 0)
+            got = meta.get("resourceVersion", 0)
+            if got != expected:
+                raise ConflictError(
+                    f"{kind} {namespace}/{name}: resourceVersion {got} != {expected}"
+                )
+            rv = self._next_rv()
+            meta["resourceVersion"] = rv
+            self._objects[key] = obj
+            self._notify(
+                WatchEvent("MODIFIED", kind, namespace, name, copy.deepcopy(obj), rv)
+            )
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        key = Key(kind, namespace, name)
+        with self._lock:
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            rv = self._next_rv()
+            self._notify(
+                WatchEvent("DELETED", kind, namespace, name, copy.deepcopy(obj), rv)
+            )
+
+    def list(self, kind: str, namespace: str | None = None) -> list[dict[str, Any]]:
+        with self._lock:
+            out = [
+                copy.deepcopy(o)
+                for k, o in self._objects.items()
+                if k.kind == kind and (namespace is None or k.namespace == namespace)
+            ]
+        out.sort(key=lambda o: (o["metadata"]["namespace"], o["metadata"]["name"]))
+        return out
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(
+        self, kind: str | None = None, namespace: str | None = None
+    ) -> "Watch":
+        w = _Watcher(q=queue.Queue(), kind=kind, namespace=namespace)
+        with self._lock:
+            self._watchers.append(w)
+        return Watch(self, w)
+
+    def _close_watch(self, w: _Watcher) -> None:
+        w.closed.set()
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+
+class Watch:
+    """Handle on a watch stream. Iterate or poll with ``next_event``."""
+
+    def __init__(self, store: Store, watcher: _Watcher):
+        self._store = store
+        self._w = watcher
+
+    def next_event(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            return self._w.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def drain(self) -> list[WatchEvent]:
+        out = []
+        while True:
+            try:
+                out.append(self._w.q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def close(self) -> None:
+        self._store._close_watch(self._w)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._w.closed.is_set():
+            ev = self.next_event(timeout=0.1)
+            if ev is not None:
+                yield ev
+
+
+def retry_on_conflict(
+    fn: Callable[[], Any], attempts: int = 5
+) -> Any:
+    """Run a read-modify-write closure, retrying on ConflictError.
+
+    The standard client-side pattern for status updates under contention
+    (the reference's Status().Update can fail the same way,
+    llmservice_controller.go:164).
+    """
+    last: Exception | None = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except ConflictError as e:  # re-read inside fn on next attempt
+            last = e
+    assert last is not None
+    raise last

@@ -1,0 +1,22 @@
+"""Coordination: lease-based leader election with automatic failover.
+
+Parity target: reference internal/agent/coordinator/election.go:17-225 —
+custom Lease CRUD election (not client-go's leaderelection), 15s TTL / 10s
+renew / 2s retry, steal-on-expiry with optimistic CAS, role-flip callbacks.
+"""
+
+from kubeinfer_tpu.coordination.lease import (
+    LEASE_DURATION_S,
+    RENEW_INTERVAL_S,
+    RETRY_INTERVAL_S,
+    Lease,
+    LeaseManager,
+)
+
+__all__ = [
+    "LEASE_DURATION_S",
+    "RENEW_INTERVAL_S",
+    "RETRY_INTERVAL_S",
+    "Lease",
+    "LeaseManager",
+]

@@ -1,0 +1,252 @@
+"""Lease-based coordinator election with automatic failover.
+
+Reference semantics reproduced (internal/agent/coordinator/election.go):
+
+- Constants 15s lease duration / 10s renew interval / 2s retry
+  (election.go:41-43). A dead coordinator is replaced within
+  ``LEASE_DURATION_S`` + one retry tick.
+- ``try_acquire_or_renew`` state machine (election.go:47-69): lease missing →
+  create (create-conflict safe, :72-104); held by me → renew (:107-120);
+  expired → steal via optimistic CAS (:123-141); held by live other → false.
+- Expiry = renew_time + duration < now (:144-155).
+- ``run`` loop fires ``on_elected``/``on_lost`` only on state *transitions*
+  (:170-225), so role goroutine/thread churn happens exactly at flips.
+
+Differences (deliberate):
+
+- Time comes from a ``Clock``; the reference calls time.Now() inline, which
+  is why its election logic has zero tests (SURVEY.md §4). With
+  ``SimulatedClock`` the failover and split-brain paths are tested
+  deterministically in milliseconds (tests/test_election.py).
+- The retry ticker is 2s like the reference's retry period; the reference
+  ticks every 2s regardless of holding (election.go:178), renewing early.
+  We renew when half the renew interval elapsed, cutting write QPS while
+  staying well inside the TTL.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from kubeinfer_tpu.controlplane.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+log = logging.getLogger(__name__)
+
+LEASE_DURATION_S = 15.0  # election.go:41
+RENEW_INTERVAL_S = 10.0  # election.go:42
+RETRY_INTERVAL_S = 2.0  # election.go:43
+
+LEASE_KIND = "Lease"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease equivalent."""
+
+    name: str
+    namespace: str = "default"
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    duration_s: float = LEASE_DURATION_S
+    resource_version: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "resourceVersion": self.resource_version,
+            },
+            "spec": {
+                "holderIdentity": self.holder,
+                "acquireTime": self.acquire_time,
+                "renewTime": self.renew_time,
+                "leaseDurationSeconds": self.duration_s,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Lease":
+        spec = d.get("spec") or {}
+        meta = d.get("metadata") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            holder=spec.get("holderIdentity", ""),
+            acquire_time=float(spec.get("acquireTime", 0.0)),
+            renew_time=float(spec.get("renewTime", 0.0)),
+            duration_s=float(spec.get("leaseDurationSeconds", LEASE_DURATION_S)),
+            resource_version=int(meta.get("resourceVersion", 0)),
+        )
+
+
+class LeaseManager:
+    """One participant in a named election.
+
+    ``identity`` is the pod name in the reference (cmd/agent/main.go:74);
+    the Lease's holderIdentity is how followers resolve the coordinator
+    (main.go:175-201), so whatever is stored here must be resolvable to an
+    endpoint by peers.
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        namespace: str,
+        lease_name: str,
+        identity: str,
+        clock: Clock | None = None,
+        duration_s: float = LEASE_DURATION_S,
+        renew_interval_s: float = RENEW_INTERVAL_S,
+        retry_interval_s: float = RETRY_INTERVAL_S,
+    ) -> None:
+        self._store = store
+        self._namespace = namespace
+        self._lease_name = lease_name
+        self.identity = identity
+        self._clock = clock or RealClock()
+        self._duration = duration_s
+        self._renew_interval = renew_interval_s
+        self._retry = retry_interval_s
+        self._mu = threading.Lock()  # guards _is_leader (election.go:26-27)
+        self._is_leader = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- state machine (election.go:47-69) --------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self._clock.now()
+        try:
+            lease = Lease.from_dict(
+                self._store.get(LEASE_KIND, self._lease_name, self._namespace)
+            )
+        except NotFoundError:
+            return self._create_lease(now)
+        if lease.holder == self.identity:
+            return self._renew_lease(lease, now)
+        if self._expired(lease, now):
+            return self._acquire_lease(lease, now)
+        return False
+
+    def _expired(self, lease: Lease, now: float) -> bool:
+        # election.go:144-155
+        return lease.renew_time + lease.duration_s < now
+
+    def _create_lease(self, now: float) -> bool:
+        # election.go:72-104 — atomic create; racing peers get AlreadyExists.
+        lease = Lease(
+            name=self._lease_name,
+            namespace=self._namespace,
+            holder=self.identity,
+            acquire_time=now,
+            renew_time=now,
+            duration_s=self._duration,
+        )
+        try:
+            self._store.create(LEASE_KIND, lease.to_dict())
+            log.info("%s created lease %s", self.identity, self._lease_name)
+            return True
+        except AlreadyExistsError:
+            return False
+
+    def _renew_lease(self, lease: Lease, now: float) -> bool:
+        # election.go:107-120. A failed CAS means someone stole it after our
+        # read (we must have expired) — report loss, next tick re-evaluates.
+        lease.renew_time = now
+        try:
+            self._store.update(LEASE_KIND, lease.to_dict())
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def _acquire_lease(self, lease: Lease, now: float) -> bool:
+        # election.go:123-141 — steal with the read resourceVersion; exactly
+        # one of N racing stealers passes the CAS.
+        lease.holder = self.identity
+        lease.acquire_time = now
+        lease.renew_time = now
+        lease.duration_s = self._duration
+        try:
+            self._store.update(LEASE_KIND, lease.to_dict())
+            log.info("%s stole lease %s", self.identity, self._lease_name)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    # -- public state ------------------------------------------------------
+
+    def is_coordinator(self) -> bool:
+        with self._mu:  # election.go:157-167
+            return self._is_leader
+
+    def get_holder(self) -> str:
+        """Current holderIdentity, "" if no lease (cmd/agent/main.go:175-187)."""
+        try:
+            lease = Lease.from_dict(
+                self._store.get(LEASE_KIND, self._lease_name, self._namespace)
+            )
+        except NotFoundError:
+            return ""
+        return lease.holder
+
+    # -- loop (election.go:170-225) ----------------------------------------
+
+    def run(
+        self,
+        on_elected: Callable[[], None],
+        on_lost: Callable[[], None],
+    ) -> None:
+        """Blocking election loop; call ``stop()`` from another thread.
+
+        Ticks every retry interval when not leading (responsive takeover) and
+        every renew interval when leading (bounded write QPS); fires
+        callbacks only on transitions.
+        """
+        while not self._stop.is_set():
+            acquired = self.try_acquire_or_renew()
+            with self._mu:
+                was = self._is_leader
+                self._is_leader = acquired
+            if acquired and not was:
+                on_elected()
+            elif was and not acquired:
+                on_lost()
+            interval = self._renew_interval / 2 if acquired else self._retry
+            self._clock.wait(self._stop, interval)
+        # On clean shutdown, surrender leadership state (the reference's
+        # context-cancel path just exits; peers take over on expiry).
+        with self._mu:
+            was = self._is_leader
+            self._is_leader = False
+        if was:
+            on_lost()
+
+    def start(
+        self,
+        on_elected: Callable[[], None],
+        on_lost: Callable[[], None],
+    ) -> threading.Thread:
+        """Run the loop in a daemon thread (agent main's `go lm.Run`)."""
+        t = threading.Thread(
+            target=self.run, args=(on_elected, on_lost), daemon=True,
+            name=f"election-{self._lease_name}-{self.identity}",
+        )
+        self._thread = t
+        t.start()
+        return t
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)

@@ -39,6 +39,11 @@ from kubeinfer_tpu.solver.problem import Problem
 
 INFEASIBLE = jnp.float32(1e9)
 _EPS = 1e-4  # capacity comparison slack for f32 fractional demands
+# Floor on the tie-spreading scale. Even at weights.noise=0, perfectly tied
+# jobs must not all bid one node per round (that caps placement at
+# max_rounds nodes and silently under-schedules); a 1e-3 perturbation is far
+# below any meaningful cost gap but keeps bids spread.
+_MIN_TIE_NOISE = 1e-3
 
 
 @dataclass(frozen=True)
@@ -66,7 +71,10 @@ class ScoreWeights:
     # it the whole fleet bids the same argmin node every round and per-round
     # acceptance collapses to one node's capacity. Noise ~0.3 spreads bids
     # across near-tied nodes while leaving real cost gaps (cache hit = 5.0,
-    # move = 8.0) intact: P(flip) < 1e-7.
+    # move = 8.0) intact: P(flip) < 1e-7. Floored at _MIN_TIE_NOISE (1e-3)
+    # even when set to 0: fully deterministic cost-exact argmin is not
+    # offered, because it caps placement at max_rounds nodes for tied
+    # fleets; fit gaps below ~2e-2 may resolve either way under the floor.
     noise: float = 0.3
 
 
@@ -113,6 +121,24 @@ def _static_cost(p: Problem, w: ScoreWeights) -> jax.Array:
     topo_miss = (pref[:, None] >= 0) & (pref[:, None] != nodes.topology[None, :])
     cost = cost + w.topology * topo_miss.astype(jnp.float32)
     return cost
+
+
+def _fit_cost(
+    gpu_free: jax.Array,  # f32[N] free capacity the fit is scored against
+    mem_free: jax.Array,
+    p: Problem,
+    w: ScoreWeights,
+    inv_gpu_cap: jax.Array,  # f32[N] 1/capacity normalizers
+    inv_mem_cap: jax.Array,
+) -> jax.Array:
+    """[J, N] best-fit pressure: normalized leftover capacity as cost."""
+    jobs = p.jobs
+    cost = w.fit_gpu * (
+        (gpu_free[None, :] - jobs.gpu_demand[:, None]) * inv_gpu_cap[None, :]
+    )
+    return cost + w.fit_mem * (
+        (mem_free[None, :] - jobs.mem_demand[:, None]) * inv_mem_cap[None, :]
+    )
 
 
 def _segmented_accept(
@@ -171,7 +197,7 @@ def _segmented_accept(
 def solve_greedy(
     p: Problem,
     weights: ScoreWeights = ScoreWeights(),
-    max_rounds: int = 32,
+    max_rounds: int = 64,
 ) -> Assignment:
     """Parallel greedy with conflict resolution (policy ``jax-greedy``)."""
     jobs, nodes = p.jobs, p.nodes
@@ -196,15 +222,10 @@ def solve_greedy(
             & node_valid_row
             & unassigned[:, None]
         )
-        fit_cost = weights.fit_gpu * (
-            (gpu_free[None, :] - jobs.gpu_demand[:, None]) * inv_gpu_cap[None, :]
-        )
-        fit_cost = fit_cost + weights.fit_mem * (
-            (mem_free[None, :] - jobs.mem_demand[:, None]) * inv_mem_cap[None, :]
-        )
+        fit_cost = _fit_cost(gpu_free, mem_free, p, weights, inv_gpu_cap, inv_mem_cap)
         # Fresh tie-spreading field each round (deterministic in the round
         # index) so repeated conflicts between the same bidders decorrelate.
-        tie_noise = weights.noise * jax.random.gumbel(
+        tie_noise = max(weights.noise, _MIN_TIE_NOISE) * jax.random.gumbel(
             jax.random.fold_in(jax.random.PRNGKey(0), rounds), (J, N), jnp.float32
         )
         cost = jnp.where(feas, static_cost + fit_cost + tie_noise, INFEASIBLE)
@@ -299,11 +320,8 @@ def solve_auction(
     # benefit: higher is better; strictly bounded so -INF marks infeasible
     inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
     inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
-    fit_cost = weights.fit_gpu * (
-        (nodes.gpu_free[None, :] - jobs.gpu_demand[:, None]) * inv_gpu_cap[None, :]
-    )
-    fit_cost = fit_cost + weights.fit_mem * (
-        (nodes.mem_free[None, :] - jobs.mem_demand[:, None]) * inv_mem_cap[None, :]
+    fit_cost = _fit_cost(
+        nodes.gpu_free, nodes.mem_free, p, weights, inv_gpu_cap, inv_mem_cap
     )
     benefit = jnp.where(feas, -(static_cost + fit_cost), -INFEASIBLE)
     NEG = -INFEASIBLE

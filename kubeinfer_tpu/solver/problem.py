@@ -215,7 +215,16 @@ def encode_problem_arrays(
                 np.int32,
             ),
             model_id=padj(
-                job_model if job_model is not None else np.zeros(J_true), 0, np.int32
+                # Out-of-table slots collapse to 0 ("no affinity") rather than
+                # letting jnp.take's clip manufacture false cache hits for
+                # whichever model owns slot MAX_MODELS-1.
+                np.where(
+                    (job_model >= 0) & (job_model < MAX_MODELS), job_model, 0
+                )
+                if job_model is not None
+                else np.zeros(J_true),
+                0,
+                np.int32,
             ),
             current_node=padj(
                 job_current_node if job_current_node is not None else np.full(J_true, -1),

@@ -1,0 +1,198 @@
+"""Control-plane store semantics: CAS, create races, watches.
+
+These are the invariants the election and controller layers depend on
+(reference analogues: election.go:72-141 create/steal races,
+llmservice_controller.go:316-321 watch-driven reconciles).
+"""
+
+import threading
+
+import pytest
+
+from kubeinfer_tpu.controlplane import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+from kubeinfer_tpu.controlplane.store import retry_on_conflict
+
+
+def obj(name, ns="default", **extra):
+    return {"metadata": {"name": name, "namespace": ns}, **extra}
+
+
+class TestCrud:
+    def test_create_get_roundtrip(self):
+        s = Store()
+        created = s.create("Lease", obj("a", spec={"holder": "p0"}))
+        assert created["metadata"]["resourceVersion"] == 1
+        got = s.get("Lease", "a")
+        assert got["spec"] == {"holder": "p0"}
+
+    def test_get_missing_raises(self):
+        with pytest.raises(NotFoundError):
+            Store().get("Lease", "nope")
+
+    def test_create_duplicate_raises(self):
+        s = Store()
+        s.create("Lease", obj("a"))
+        with pytest.raises(AlreadyExistsError):
+            s.create("Lease", obj("a"))
+
+    def test_update_requires_matching_rv(self):
+        s = Store()
+        created = s.create("Lease", obj("a", spec={"holder": "p0"}))
+        stale = {**created, "spec": {"holder": "p1"}}
+        fresh = s.update("Lease", {**created, "spec": {"holder": "p0x"}})
+        assert fresh["metadata"]["resourceVersion"] > created["metadata"]["resourceVersion"]
+        with pytest.raises(ConflictError):
+            s.update("Lease", stale)  # rv already consumed
+
+    def test_delete_then_get_raises(self):
+        s = Store()
+        s.create("Workload", obj("w"))
+        s.delete("Workload", "w")
+        with pytest.raises(NotFoundError):
+            s.get("Workload", "w")
+
+    def test_list_filters_kind_and_namespace(self):
+        s = Store()
+        s.create("Lease", obj("a", ns="ns1"))
+        s.create("Lease", obj("b", ns="ns2"))
+        s.create("Workload", obj("c", ns="ns1"))
+        assert [o["metadata"]["name"] for o in s.list("Lease")] == ["a", "b"]
+        assert [o["metadata"]["name"] for o in s.list("Lease", "ns2")] == ["b"]
+
+    def test_store_returns_copies_not_aliases(self):
+        s = Store()
+        src = obj("a", spec={"holder": "p0"})
+        created = s.create("Lease", src)
+        src["spec"]["holder"] = "mutated"
+        created["spec"]["holder"] = "also-mutated"
+        assert s.get("Lease", "a")["spec"]["holder"] == "p0"
+
+
+class TestCreateRace:
+    def test_concurrent_creates_one_winner(self):
+        """The election primitive: N racing creates -> exactly 1 success."""
+        s = Store()
+        results = []
+        barrier = threading.Barrier(8)
+
+        def attempt(i):
+            barrier.wait()
+            try:
+                s.create("Lease", obj("election", spec={"holder": f"p{i}"}))
+                results.append(("win", i))
+            except AlreadyExistsError:
+                results.append(("lose", i))
+
+        threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(1 for r, _ in results if r == "win") == 1
+
+    def test_concurrent_cas_updates_one_winner_per_rv(self):
+        s = Store()
+        base = s.create("Lease", obj("l", spec={"n": 0}))
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def attempt(i):
+            barrier.wait()
+            try:
+                s.update("Lease", {**base, "spec": {"n": i}})
+                wins.append(i)
+            except ConflictError:
+                pass
+
+        threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert s.get("Lease", "l")["spec"]["n"] == wins[0]
+
+
+class TestWatch:
+    def test_watch_sees_ordered_lifecycle(self):
+        s = Store()
+        w = s.watch(kind="Workload")
+        s.create("Workload", obj("w"))
+        got = s.get("Workload", "w")
+        got["ready"] = True
+        s.update("Workload", got)
+        s.delete("Workload", "w")
+        events = [w.next_event(timeout=1).type for _ in range(3)]
+        assert events == ["ADDED", "MODIFIED", "DELETED"]
+        w.close()
+
+    def test_watch_filters_kind(self):
+        s = Store()
+        w = s.watch(kind="Lease")
+        s.create("Workload", obj("w"))
+        s.create("Lease", obj("l"))
+        ev = w.next_event(timeout=1)
+        assert ev.kind == "Lease" and ev.name == "l"
+        assert w.next_event(timeout=0.05) is None
+        w.close()
+
+    def test_closed_watch_receives_nothing(self):
+        s = Store()
+        w = s.watch()
+        w.close()
+        s.create("Lease", obj("l"))
+        assert w.next_event(timeout=0.05) is None
+
+
+class TestRetryOnConflict:
+    def test_retries_until_success(self):
+        s = Store()
+        s.create("LLMService", obj("svc", status={"n": 0}))
+
+        def bump():
+            cur = s.get("LLMService", "svc")
+            cur["status"]["n"] += 1
+            return s.update("LLMService", cur)
+
+        # interleave a conflicting writer on the first read-modify-write
+        calls = {"n": 0}
+        real_get = s.get
+
+        def racing_get(kind, name, namespace="default"):
+            out = real_get(kind, name, namespace)
+            if calls["n"] == 0:
+                calls["n"] += 1
+                interloper = real_get(kind, name, namespace)
+                s.update(kind, interloper)  # consume the rv
+            return out
+
+        s.get = racing_get  # type: ignore[method-assign]
+        result = retry_on_conflict(bump)
+        assert result["status"]["n"] == 1
+
+
+class TestReviewRegressions:
+    def test_update_without_namespace_keeps_default_namespace(self):
+        s = Store()
+        s.create("Lease", {"metadata": {"name": "a"}})
+        cur = s.get("Lease", "a")
+        del cur["metadata"]["namespace"]
+        s.update("Lease", cur)
+        assert s.get("Lease", "a")["metadata"]["namespace"] == "default"
+        assert [o["metadata"]["name"] for o in s.list("Lease")] == ["a"]
+
+    def test_watchers_do_not_alias_event_objects(self):
+        s = Store()
+        w1, w2 = s.watch(kind="Lease"), s.watch(kind="Lease")
+        s.create("Lease", obj("a", spec={"holder": "p0"}))
+        e1 = w1.next_event(timeout=1)
+        e1.object["spec"]["holder"] = "mutated"
+        e2 = w2.next_event(timeout=1)
+        assert e2.object["spec"]["holder"] == "p0"
+        w1.close()
+        w2.close()
