@@ -84,21 +84,23 @@ class Store:
         self._rv += 1
         return self._rv
 
-    def _notify(self, ev: WatchEvent) -> None:
+    def _notify(
+        self, type_: str, kind: str, namespace: str, name: str,
+        obj: dict[str, Any], rv: int,
+    ) -> None:
+        """Fan out one event. ``obj`` is the store's own dict; each delivered
+        watcher gets its own deep copy (consumers may normalize events in
+        place and must not see each other's — or the store's — state), and
+        nothing is copied when no watcher matches."""
         for w in self._watchers:
             if w.closed.is_set():
                 continue
-            if w.kind is not None and w.kind != ev.kind:
+            if w.kind is not None and w.kind != kind:
                 continue
-            if w.namespace is not None and w.namespace != ev.namespace:
+            if w.namespace is not None and w.namespace != namespace:
                 continue
-            # Each watcher gets its own object copy: consumers may normalize
-            # events in place and must not see each other's mutations.
             w.q.put(
-                WatchEvent(
-                    ev.type, ev.kind, ev.namespace, ev.name,
-                    copy.deepcopy(ev.object), ev.resource_version,
-                )
+                WatchEvent(type_, kind, namespace, name, copy.deepcopy(obj), rv)
             )
 
     # -- CRUD ------------------------------------------------------------
@@ -119,7 +121,7 @@ class Store:
             meta["resourceVersion"] = rv
             meta.setdefault("generation", 1)
             self._objects[key] = obj
-            self._notify(WatchEvent("ADDED", kind, namespace, name, copy.deepcopy(obj), rv))
+            self._notify("ADDED", kind, namespace, name, obj, rv)
             return copy.deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
@@ -151,9 +153,7 @@ class Store:
             rv = self._next_rv()
             meta["resourceVersion"] = rv
             self._objects[key] = obj
-            self._notify(
-                WatchEvent("MODIFIED", kind, namespace, name, copy.deepcopy(obj), rv)
-            )
+            self._notify("MODIFIED", kind, namespace, name, obj, rv)
             return copy.deepcopy(obj)
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
@@ -163,9 +163,7 @@ class Store:
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             rv = self._next_rv()
-            self._notify(
-                WatchEvent("DELETED", kind, namespace, name, copy.deepcopy(obj), rv)
-            )
+            self._notify("DELETED", kind, namespace, name, obj, rv)
 
     def list(self, kind: str, namespace: str | None = None) -> list[dict[str, Any]]:
         with self._lock:

@@ -1,0 +1,21 @@
+"""Native tier: ctypes bindings for libkubeinfer_native.so.
+
+The reference has no native components (100% Go, SURVEY.md §2); the native
+tier here exists for the runtime pieces that must stay off the accelerator —
+today the serial baseline scorer the TPU solver is measured against
+(BASELINE.json north star "≥100× the serial scorer").
+"""
+
+from kubeinfer_tpu.native.lib import (
+    NativeLibraryError,
+    load_native,
+    native_available,
+    solve_greedy_native,
+)
+
+__all__ = [
+    "NativeLibraryError",
+    "load_native",
+    "native_available",
+    "solve_greedy_native",
+]

@@ -1,0 +1,26 @@
+"""Scheduler backends: the pluggable placement layer.
+
+The ``SchedulerBackend`` interface is the north-star architecture from
+BASELINE.json: the controller batches pending jobs + node-state vectors into
+one dense request per tick and hands it to the backend selected by each
+job's ``schedulerPolicy`` — the serial native scorer (baseline/fallback) or
+the batched JAX solvers (TPU path).
+"""
+
+from kubeinfer_tpu.scheduler.backends import (
+    JaxBackend,
+    NativeGreedyBackend,
+    SchedulerBackend,
+    SolveRequest,
+    SolveResult,
+    get_backend,
+)
+
+__all__ = [
+    "JaxBackend",
+    "NativeGreedyBackend",
+    "SchedulerBackend",
+    "SolveRequest",
+    "SolveResult",
+    "get_backend",
+]

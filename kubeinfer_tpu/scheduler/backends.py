@@ -1,0 +1,198 @@
+"""SchedulerBackend interface + implementations.
+
+``SolveRequest`` is the host-side problem description (numpy SoA, unpadded):
+the same shape the controller builds per tick, the sidecar service ships
+over its wire protocol, and both solver tiers consume. ``SolveResult``
+carries the assignment plus timing diagnostics the metrics layer exports
+(per-solve latency is a first-class product requirement — BASELINE.json's
+driver metric is p50 assign latency).
+
+Backend selection: ``get_backend(policy)`` maps the ``schedulerPolicy`` spec
+field to an implementation (SURVEY.md §7: "pluggable SchedulerBackend
+selected by a new schedulerPolicy spec field").
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubeinfer_tpu.api.types import SchedulerPolicy
+
+
+@dataclass
+class SolveRequest:
+    """One tick's batched placement problem (host-side, unpadded).
+
+    Conventions match solver.problem.encode_problem_arrays: one job row per
+    replica; gang ids couple rows all-or-nothing; current_node (-1 = none)
+    feeds move hysteresis; node_cached is a [N, M] model-slot bitmap.
+    """
+
+    job_gpu: np.ndarray
+    job_mem_gib: np.ndarray
+    node_gpu_free: np.ndarray
+    node_mem_free_gib: np.ndarray
+    job_priority: np.ndarray | None = None
+    job_gang: np.ndarray | None = None
+    job_model: np.ndarray | None = None
+    job_current_node: np.ndarray | None = None
+    node_gpu_capacity: np.ndarray | None = None
+    node_mem_capacity_gib: np.ndarray | None = None
+    node_topology: np.ndarray | None = None
+    node_cached: np.ndarray | None = None
+
+    @property
+    def num_jobs(self) -> int:
+        return int(self.job_gpu.shape[0])
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node_gpu_free.shape[0])
+
+
+@dataclass
+class SolveResult:
+    """Assignment (node index per job, -1 unplaced) + diagnostics."""
+
+    assignment: np.ndarray  # i32[J]
+    placed: int
+    solve_ms: float
+    policy: str
+    rounds: int = 0
+    extras: dict[str, float] = field(default_factory=dict)
+
+
+class SchedulerBackend:
+    """Places a batch of replicas onto nodes."""
+
+    name = "abstract"
+
+    def solve(self, req: SolveRequest) -> SolveResult:
+        raise NotImplementedError
+
+    def warmup(self) -> None:
+        """Pre-pay one-time costs (jit compiles, library builds) so the
+        first production tick stays inside the latency budget."""
+
+
+class NativeGreedyBackend(SchedulerBackend):
+    """Serial first-fit-decreasing via the C++ native tier.
+
+    The comparison baseline for the >=100x claim and the no-accelerator
+    fallback. Import is deferred so environments without a compiler can
+    still use the JAX backends.
+    """
+
+    name = SchedulerPolicy.NATIVE_GREEDY.value
+
+    def warmup(self) -> None:
+        from kubeinfer_tpu.native import load_native
+
+        load_native()
+
+    def solve(self, req: SolveRequest) -> SolveResult:
+        from kubeinfer_tpu.native import solve_greedy_native
+
+        t0 = time.perf_counter()
+        assignment, placed = solve_greedy_native(
+            job_gpu=req.job_gpu,
+            job_mem_gib=req.job_mem_gib,
+            job_priority=req.job_priority,
+            job_gang=req.job_gang,
+            job_model=req.job_model,
+            job_current_node=req.job_current_node,
+            node_gpu_free=req.node_gpu_free,
+            node_mem_free_gib=req.node_mem_free_gib,
+            node_gpu_capacity=req.node_gpu_capacity,
+            node_mem_capacity_gib=req.node_mem_capacity_gib,
+            node_topology=req.node_topology,
+            node_cached=req.node_cached,
+        )
+        ms = (time.perf_counter() - t0) * 1e3
+        return SolveResult(assignment, placed, ms, self.name)
+
+
+class JaxBackend(SchedulerBackend):
+    """Batched solve on the live JAX backend (TPU when present).
+
+    One instance per policy (greedy/auction). Encoding pads both axes to
+    buckets so the jit cache stays small; ``warmup`` pre-compiles the
+    bucket a deployment expects to hit.
+    """
+
+    def __init__(self, policy: SchedulerPolicy):
+        if policy not in (SchedulerPolicy.JAX_GREEDY, SchedulerPolicy.JAX_AUCTION):
+            raise ValueError(f"not a JAX policy: {policy}")
+        self._policy = policy
+        self.name = policy.value
+
+    def warmup(
+        self, num_jobs: int = 1024, num_nodes: int = 128
+    ) -> None:
+        req = SolveRequest(
+            job_gpu=np.ones(num_jobs, np.float32),
+            job_mem_gib=np.ones(num_jobs, np.float32),
+            node_gpu_free=np.full(num_nodes, 8.0, np.float32),
+            node_mem_free_gib=np.full(num_nodes, 64.0, np.float32),
+        )
+        self.solve(req)
+
+    def solve(self, req: SolveRequest) -> SolveResult:
+        import jax
+
+        from kubeinfer_tpu.solver import solve as jax_solve
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        t0 = time.perf_counter()
+        problem = encode_problem_arrays(
+            job_gpu=req.job_gpu,
+            job_mem_gib=req.job_mem_gib,
+            job_priority=req.job_priority,
+            job_gang=req.job_gang,
+            job_model=req.job_model,
+            job_current_node=req.job_current_node,
+            node_gpu_free=req.node_gpu_free,
+            node_mem_free_gib=req.node_mem_free_gib,
+            node_gpu_capacity=req.node_gpu_capacity,
+            node_mem_capacity_gib=req.node_mem_capacity_gib,
+            node_topology=req.node_topology,
+            node_cached=req.node_cached,
+        )
+        t_encode = time.perf_counter()
+        out = jax_solve(problem, policy=self._policy.value)
+        assignment = np.asarray(
+            jax.device_get(out.node)[: req.num_jobs], np.int32
+        )
+        # Padded job rows can't place (valid=False) and padded node columns
+        # can't be chosen (valid=False), so clipping to the true axes is
+        # lossless; count placed on the clipped view.
+        placed = int((assignment >= 0).sum())
+        t1 = time.perf_counter()
+        return SolveResult(
+            assignment,
+            placed,
+            (t1 - t0) * 1e3,
+            self.name,
+            rounds=int(out.rounds),
+            extras={"encode_ms": (t_encode - t0) * 1e3},
+        )
+
+
+_BACKENDS: dict[str, SchedulerBackend] = {}
+
+
+def get_backend(policy: str | SchedulerPolicy) -> SchedulerBackend:
+    """Backend for a schedulerPolicy value; instances are cached (jit
+    caches and native lib handles live on them)."""
+    policy = SchedulerPolicy(policy)
+    backend = _BACKENDS.get(policy.value)
+    if backend is None:
+        if policy is SchedulerPolicy.NATIVE_GREEDY:
+            backend = NativeGreedyBackend()
+        else:
+            backend = JaxBackend(policy)
+        _BACKENDS[policy.value] = backend
+    return backend

@@ -1,0 +1,155 @@
+// Serial greedy first-fit-decreasing scorer — the comparison baseline.
+//
+// This is the explicit form of the scheduling the reference delegates to
+// kube-scheduler (it emits a Deployment and never places pods itself,
+// internal/controller/llmservice_controller.go:193-312). SURVEY.md §7 step 2
+// requires it as the serial anchor the TPU solver's >=100x claim is measured
+// against, and it doubles as the no-accelerator fallback backend
+// (schedulerPolicy: native-greedy).
+//
+// Cost model parity with kubeinfer_tpu/solver/core.py (_static_cost +
+// _fit_cost), minus the tie-spreading noise: a serial loop commits one job at
+// a time, so tied jobs can't collide the way a batched bidder fleet can.
+//
+// C ABI only (loaded via ctypes); no globals, no exceptions across the
+// boundary.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+constexpr float kEps = 1e-4f;  // capacity slack, matches core.py _EPS
+
+struct Weights {
+  float fit_gpu;
+  float fit_mem;
+  float cache;
+  float move;
+  float topology;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Solve one scheduling instance serially.
+//
+// Inputs are structure-of-arrays, unpadded. node_cached is a row-major
+// [num_nodes x max_models] byte bitmap. weights points at 5 floats
+// (fit_gpu, fit_mem, cache, move, topology). out_assign receives the node
+// index per job (-1 = unplaced). Gang groups (gang_id >= 0) are
+// all-or-nothing: incompletely placed gangs are unwound before returning.
+// Returns the number of placed jobs, or -1 on invalid arguments.
+int ki_solve_greedy(
+    int num_jobs, int num_nodes,
+    const float* job_gpu, const float* job_mem, const float* job_priority,
+    const int32_t* job_gang, const int32_t* job_model,
+    const int32_t* job_current,
+    const float* node_gpu_free, const float* node_mem_free,
+    const float* node_gpu_cap, const float* node_mem_cap,
+    const int32_t* node_topology, const uint8_t* node_cached, int max_models,
+    const float* weights, int32_t* out_assign) {
+  if (num_jobs < 0 || num_nodes < 0 || max_models < 0) return -1;
+  if (!job_gpu || !job_mem || !job_priority || !job_gang || !job_model ||
+      !job_current || !node_gpu_free || !node_mem_free || !node_gpu_cap ||
+      !node_mem_cap || !node_topology || !node_cached || !weights ||
+      !out_assign)
+    return -1;
+
+  const Weights w{weights[0], weights[1], weights[2], weights[3], weights[4]};
+
+  std::vector<float> gpu_free(node_gpu_free, node_gpu_free + num_nodes);
+  std::vector<float> mem_free(node_mem_free, node_mem_free + num_nodes);
+  std::vector<float> inv_gpu_cap(num_nodes), inv_mem_cap(num_nodes);
+  for (int n = 0; n < num_nodes; ++n) {
+    inv_gpu_cap[n] = 1.0f / std::max(node_gpu_cap[n], 1.0f);
+    inv_mem_cap[n] = 1.0f / std::max(node_mem_cap[n], 1.0f);
+  }
+
+  // First-fit-decreasing order: priority desc, then gpu demand desc, then
+  // index for determinism.
+  std::vector<int> order(num_jobs);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+    if (job_priority[a] != job_priority[b])
+      return job_priority[a] > job_priority[b];
+    if (job_gpu[a] != job_gpu[b]) return job_gpu[a] > job_gpu[b];
+    return a < b;
+  });
+
+  std::fill(out_assign, out_assign + num_jobs, -1);
+
+  for (int idx = 0; idx < num_jobs; ++idx) {
+    const int j = order[idx];
+    const float gd = job_gpu[j], md = job_mem[j];
+    const int cur = job_current[j];
+    const int model = job_model[j];
+    const int pref_topo =
+        (cur >= 0 && cur < num_nodes) ? node_topology[cur] : -1;
+
+    int best = -1;
+    float best_cost = 0.0f;
+    for (int n = 0; n < num_nodes; ++n) {
+      if (gd > gpu_free[n] + kEps || md > mem_free[n] + kEps) continue;
+      float cost = w.fit_gpu * (gpu_free[n] - gd) * inv_gpu_cap[n] +
+                   w.fit_mem * (mem_free[n] - md) * inv_mem_cap[n];
+      const bool hit = model >= 0 && model < max_models &&
+                       node_cached[static_cast<size_t>(n) * max_models + model];
+      if (!hit) cost += w.cache;
+      if (cur >= 0 && cur != n) cost += w.move;
+      if (pref_topo >= 0 && node_topology[n] != pref_topo) cost += w.topology;
+      if (best < 0 || cost < best_cost) {
+        best = n;
+        best_cost = cost;
+      }
+    }
+    if (best >= 0) {
+      out_assign[j] = best;
+      gpu_free[best] -= gd;
+      mem_free[best] -= md;
+    }
+  }
+
+  // Gang repair: all-or-nothing (parity with core.py _gang_repair).
+  // Gang ids are arbitrary non-negative ints; count need/got per id.
+  std::vector<int64_t> gangs;
+  for (int j = 0; j < num_jobs; ++j)
+    if (job_gang[j] >= 0) gangs.push_back(job_gang[j]);
+  if (!gangs.empty()) {
+    std::sort(gangs.begin(), gangs.end());
+    gangs.erase(std::unique(gangs.begin(), gangs.end()), gangs.end());
+    auto gang_slot = [&](int32_t g) {
+      return std::lower_bound(gangs.begin(), gangs.end(), g) - gangs.begin();
+    };
+    std::vector<int> need(gangs.size(), 0), got(gangs.size(), 0);
+    for (int j = 0; j < num_jobs; ++j) {
+      if (job_gang[j] < 0) continue;
+      const auto s = gang_slot(job_gang[j]);
+      ++need[s];
+      if (out_assign[j] >= 0) ++got[s];
+    }
+    for (int j = 0; j < num_jobs; ++j) {
+      if (job_gang[j] < 0 || out_assign[j] < 0) continue;
+      const auto s = gang_slot(job_gang[j]);
+      if (got[s] != need[s]) {
+        gpu_free[out_assign[j]] += job_gpu[j];
+        mem_free[out_assign[j]] += job_mem[j];
+        out_assign[j] = -1;
+      }
+    }
+  }
+
+  int placed = 0;
+  for (int j = 0; j < num_jobs; ++j)
+    if (out_assign[j] >= 0) ++placed;
+  return placed;
+}
+
+// ABI version tag so the Python loader can detect stale .so builds.
+int ki_abi_version() { return 1; }
+
+}  // extern "C"

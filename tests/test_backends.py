@@ -1,0 +1,167 @@
+"""SchedulerBackend layer: native C++ scorer vs JAX solvers.
+
+The native scorer is the serial baseline the TPU path is measured against
+(BASELINE.json north star); these tests pin both tiers to the same
+feasibility invariants so the benchmark comparison is apples-to-apples.
+"""
+
+import numpy as np
+import pytest
+
+from kubeinfer_tpu.api.types import SchedulerPolicy
+from kubeinfer_tpu.scheduler import (
+    JaxBackend,
+    NativeGreedyBackend,
+    SolveRequest,
+    get_backend,
+)
+
+native = pytest.importorskip("kubeinfer_tpu.native")
+if not native.native_available():
+    pytest.skip("native library unavailable (no compiler?)", allow_module_level=True)
+
+
+def small_request(**over):
+    base = dict(
+        job_gpu=np.array([2, 2, 4, 1], np.float32),
+        job_mem_gib=np.array([10, 10, 20, 5], np.float32),
+        node_gpu_free=np.array([4, 4, 8], np.float32),
+        node_mem_free_gib=np.array([40, 40, 80], np.float32),
+    )
+    base.update(over)
+    return SolveRequest(**base)
+
+
+def check_capacity(req, assignment):
+    used_gpu = np.zeros(req.num_nodes)
+    used_mem = np.zeros(req.num_nodes)
+    for j, n in enumerate(assignment):
+        if n >= 0:
+            used_gpu[n] += req.job_gpu[j]
+            used_mem[n] += req.job_mem_gib[j]
+    assert (used_gpu <= req.node_gpu_free + 1e-3).all()
+    assert (used_mem <= req.node_mem_free_gib + 1e-3).all()
+
+
+class TestNativeGreedy:
+    def test_places_all_when_capacity_suffices(self):
+        req = small_request()
+        res = NativeGreedyBackend().solve(req)
+        assert res.placed == 4
+        assert (res.assignment >= 0).all()
+        check_capacity(req, res.assignment)
+
+    def test_respects_capacity_when_oversubscribed(self):
+        req = small_request(
+            job_gpu=np.full(10, 4.0, np.float32),
+            job_mem_gib=np.full(10, 10.0, np.float32),
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert res.placed == 4  # 4+4+8 chips / 4 each
+        check_capacity(req, res.assignment)
+
+    def test_priority_wins_scarce_capacity(self):
+        req = small_request(
+            job_gpu=np.array([4, 4], np.float32),
+            job_mem_gib=np.array([1, 1], np.float32),
+            job_priority=np.array([0, 10], np.float32),
+            node_gpu_free=np.array([4], np.float32),
+            node_mem_free_gib=np.array([100], np.float32),
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert res.assignment[1] == 0
+        assert res.assignment[0] == -1
+
+    def test_cache_affinity_preferred(self):
+        req = small_request(
+            job_gpu=np.array([1], np.float32),
+            job_mem_gib=np.array([1], np.float32),
+            job_model=np.array([3], np.int32),
+            node_gpu_free=np.array([8, 8], np.float32),
+            node_mem_free_gib=np.array([64, 64], np.float32),
+            node_cached=np.eye(8, dtype=np.uint8)[[0, 3]],  # node1 caches model 3
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert res.assignment[0] == 1
+
+    def test_move_hysteresis_keeps_incumbent(self):
+        req = small_request(
+            job_gpu=np.array([1], np.float32),
+            job_mem_gib=np.array([1], np.float32),
+            job_current_node=np.array([1], np.int32),
+            node_gpu_free=np.array([8, 8], np.float32),
+            node_mem_free_gib=np.array([64, 64], np.float32),
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert res.assignment[0] == 1
+
+    def test_gang_all_or_nothing(self):
+        # gang of 3 with only 2 placeable slots -> whole gang unwound
+        req = small_request(
+            job_gpu=np.array([4, 4, 4, 1], np.float32),
+            job_mem_gib=np.ones(4, np.float32),
+            job_gang=np.array([7, 7, 7, -1], np.int32),
+            node_gpu_free=np.array([4, 5], np.float32),
+            node_mem_free_gib=np.full(2, 100, np.float32),
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert (res.assignment[:3] == -1).all()
+        assert res.assignment[3] >= 0
+
+    def test_empty_problem(self):
+        req = small_request(
+            job_gpu=np.zeros(0, np.float32),
+            job_mem_gib=np.zeros(0, np.float32),
+        )
+        res = NativeGreedyBackend().solve(req)
+        assert res.placed == 0
+        assert res.assignment.shape == (0,)
+
+
+class TestParityAcrossTiers:
+    """Native and JAX tiers must agree on placement quality invariants."""
+
+    @pytest.mark.parametrize(
+        "policy",
+        [SchedulerPolicy.NATIVE_GREEDY, SchedulerPolicy.JAX_GREEDY],
+    )
+    def test_full_placement_parity(self, policy):
+        rng = np.random.default_rng(0)
+        req = SolveRequest(
+            job_gpu=rng.integers(1, 4, 64).astype(np.float32),
+            job_mem_gib=rng.integers(1, 16, 64).astype(np.float32),
+            node_gpu_free=np.full(32, 16.0, np.float32),
+            node_mem_free_gib=np.full(32, 128.0, np.float32),
+        )
+        res = get_backend(policy).solve(req)
+        assert res.placed == 64, f"{policy}: {res.placed}"
+        check_capacity(req, res.assignment)
+
+    @pytest.mark.parametrize(
+        "policy",
+        [SchedulerPolicy.NATIVE_GREEDY, SchedulerPolicy.JAX_GREEDY,
+         SchedulerPolicy.JAX_AUCTION],
+    )
+    def test_oversubscribed_respects_capacity(self, policy):
+        rng = np.random.default_rng(1)
+        req = SolveRequest(
+            job_gpu=rng.integers(1, 8, 128).astype(np.float32),
+            job_mem_gib=rng.integers(1, 8, 128).astype(np.float32),
+            node_gpu_free=np.full(8, 8.0, np.float32),
+            node_mem_free_gib=np.full(8, 64.0, np.float32),
+        )
+        res = get_backend(policy).solve(req)
+        assert 0 < res.placed < 128
+        check_capacity(req, res.assignment)
+
+
+class TestBackendRegistry:
+    def test_get_backend_accepts_strings_and_caches(self):
+        b1 = get_backend("native-greedy")
+        b2 = get_backend(SchedulerPolicy.NATIVE_GREEDY)
+        assert b1 is b2
+        assert isinstance(get_backend("jax-auction"), JaxBackend)
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            get_backend("hungarian-on-abacus")
