@@ -1,0 +1,42 @@
+"""Prometheus-style metrics (parity: reference pkg/metrics/metrics.go).
+
+The reference registers six collectors but only wires two
+(metrics.go:27-147; SURVEY.md §2 #10 "the other four collectors/helpers are
+dead wiring"). Here every collector is recorded by the component that owns
+it, plus the new solver metrics the north star requires (per-solve latency,
+placement quality).
+"""
+
+from kubeinfer_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+    coordinator_elections_total,
+    llmservice_ready_replicas,
+    llmservice_total,
+    model_download_duration_seconds,
+    reconcile_duration_seconds,
+    reconcile_total,
+    solve_duration_seconds,
+    solve_placement_ratio,
+    solve_problem_size,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "coordinator_elections_total",
+    "llmservice_ready_replicas",
+    "llmservice_total",
+    "model_download_duration_seconds",
+    "reconcile_duration_seconds",
+    "reconcile_total",
+    "solve_duration_seconds",
+    "solve_placement_ratio",
+    "solve_problem_size",
+]

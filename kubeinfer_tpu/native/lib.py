@@ -29,9 +29,12 @@ class NativeLibraryError(RuntimeError):
 
 
 def _build() -> None:
-    proc = subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
-    )
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)], capture_output=True, text=True
+        )
+    except FileNotFoundError as e:  # no make on PATH
+        raise NativeLibraryError(f"building native library failed: {e}") from e
     if proc.returncode != 0:
         raise NativeLibraryError(
             f"building native library failed: `make -C {_NATIVE_DIR}` "
@@ -139,6 +142,8 @@ def solve_greedy_native(
         raise ValueError(
             f"node_cached shape {node_cached.shape} != ({N}, num_models)"
         )
+    if len(weights) != 5:
+        raise ValueError(f"weights must have 5 elements, got {len(weights)}")
 
     def f32(a, default=None):
         if a is None:

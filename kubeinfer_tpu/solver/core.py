@@ -11,6 +11,10 @@ Two device-side algorithms, selected per job via ``schedulerPolicy``:
   still-unplaced job provably had no feasible node left. This is the
   TPU-shaped replacement for a serial first-fit loop: rounds are O(J*N)
   dense vector ops (VPU/HBM-friendly) instead of 10k sequential decisions.
+  Priority classes are released one per round (class k bids from round k,
+  see MAX_PRIORITY_CLASSES): per-node accept order alone can't stop a
+  low-priority job from committing capacity on a node the high-priority
+  class only discovers a round later.
 
 ``solve_auction`` — Bertsekas-style auction for one-replica-per-node
   instances (whole-node requests), giving Hungarian-quality assignments
@@ -44,6 +48,12 @@ _EPS = 1e-4  # capacity comparison slack for f32 fractional demands
 # max_rounds nodes and silently under-schedules); a 1e-3 perturbation is far
 # below any meaningful cost gap but keeps bids spread.
 _MIN_TIE_NOISE = 1e-3
+# Priority classes are released into the bidding one per round (class k may
+# bid from round k). Without this gating, low-priority jobs commit capacity
+# in round 1 on nodes a high-priority job only discovers in round 2 —
+# priority inversion under contention. Distinct priorities beyond this many
+# classes share the last class (accept order still ranks them per node).
+MAX_PRIORITY_CLASSES = 16
 
 
 @dataclass(frozen=True)
@@ -208,14 +218,30 @@ def solve_greedy(
     inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
     inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
 
+    # Dense priority rank (0 = highest priority class), clamped to
+    # MAX_PRIORITY_CLASSES. Class k joins the bidding at round k.
+    neg_p = jnp.where(jobs.valid, -jobs.priority, jnp.inf)
+    order_p = jnp.argsort(neg_p)
+    sorted_p = neg_p[order_p]
+    is_new = jnp.concatenate(
+        [jnp.zeros((1,), bool), sorted_p[1:] > sorted_p[:-1]]
+    )
+    dense_rank = jnp.cumsum(is_new.astype(jnp.int32))
+    rank = jnp.zeros((J,), jnp.int32).at[order_p].set(dense_rank)
+    rank = jnp.minimum(rank, MAX_PRIORITY_CLASSES - 1)
+    max_rank = jnp.max(jnp.where(jobs.valid, rank, 0))
+
     def cond(state):
         assigned, gpu_free, mem_free, rounds, progress = state
         pending = jnp.any((assigned < 0) & jobs.valid)
-        return progress & pending & (rounds < max_rounds)
+        # keep looping while classes are still being released even if the
+        # already-released classes made no progress this round
+        alive = progress | (rounds <= max_rank)
+        return alive & pending & (rounds < max_rounds)
 
     def body(state):
         assigned, gpu_free, mem_free, rounds, _ = state
-        unassigned = (assigned < 0) & jobs.valid
+        unassigned = (assigned < 0) & jobs.valid & (rank <= rounds)
         feas = (
             (jobs.gpu_demand[:, None] <= gpu_free[None, :] + _EPS)
             & (jobs.mem_demand[:, None] <= mem_free[None, :] + _EPS)
@@ -306,6 +332,11 @@ def solve_auction(
     Feasible means the whole remaining node capacity satisfies the demand;
     each node hosts at most one replica. Within-eps-optimal total cost for
     the jobs it places (standard auction guarantee: J*eps of optimal).
+
+    Priority does NOT influence auction outcomes (a per-job constant in the
+    benefit cancels out of the bid increments): when preemption matters,
+    use ``jax-greedy`` (priority-gated rounds) or ``native-greedy``
+    (priority-sorted serial pass).
     """
     jobs, nodes = p.jobs, p.nodes
     J = jobs.valid.shape[0]

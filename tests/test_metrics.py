@@ -1,0 +1,72 @@
+"""Metrics registry: collector semantics + Prometheus text exposition."""
+
+import pytest
+
+from kubeinfer_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+
+
+class TestCounter:
+    def test_inc_and_labels(self):
+        r = Registry()
+        c = Counter("t_total", "help", labels=("result",), registry=r)
+        c.inc("ok")
+        c.inc("ok", by=2)
+        c.inc("err")
+        assert c.value("ok") == 3
+        assert c.value("err") == 1
+        assert c.value("missing") == 0
+
+    def test_label_arity_enforced(self):
+        c = Counter("t2_total", "h", labels=("a", "b"), registry=None)
+        with pytest.raises(ValueError):
+            c.inc("only-one")
+
+
+class TestGauge:
+    def test_set_and_delete(self):
+        g = Gauge("t_gauge", "h", labels=("ns", "name"), registry=None)
+        g.set("default", "svc", 3)
+        assert g.value("default", "svc") == 3
+        g.delete("default", "svc")
+        assert g.value("default", "svc") == 0
+
+    def test_unlabeled_set(self):
+        g = Gauge("t_g2", "h", registry=None)
+        g.set(7)
+        assert g.value() == 7
+
+
+class TestHistogram:
+    def test_cumulative_buckets(self):
+        h = Histogram("t_seconds", "h", buckets=[0.1, 1.0, 10.0], registry=None)
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+        text = h.render()
+        assert 'le="0.1"} 1' in text
+        assert 'le="1"} 2' in text
+        assert 'le="10"} 3' in text
+        assert 'le="+Inf"} 4' in text
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(55.55)
+
+    def test_labeled_histogram(self):
+        h = Histogram("t_s2", "h", buckets=[1], labels=("policy",), registry=None)
+        h.observe("jax-greedy", 0.5)
+        h.observe("jax-greedy", 2.0)
+        assert h.count("jax-greedy") == 2
+        assert 'policy="jax-greedy",le="+Inf"} 2' in h.render()
+
+
+class TestRegistry:
+    def test_render_and_reset(self):
+        r = Registry()
+        c = Counter("x_total", "counts x", registry=r)
+        c.inc()
+        text = r.render()
+        assert "# HELP x_total counts x" in text
+        assert "# TYPE x_total counter" in text
+        assert "x_total 1" in text
+        r.reset()
+        assert "x_total 1" not in r.render()

@@ -1,0 +1,308 @@
+"""Batched reconciler behavior.
+
+Covers the reference controller's contract (create-if-missing, status sync
+— llmservice_controller_test.go had only a no-error smoke test) plus the
+gaps this build fixes: drift correction, GC, explicit solver placement,
+preemption under churn.
+"""
+
+import numpy as np
+
+from kubeinfer_tpu.api.types import LLMService, LLMServiceSpec, SchedulerPolicy
+from kubeinfer_tpu.api.workload import NodeState, Workload
+from kubeinfer_tpu.controller import Controller
+from kubeinfer_tpu.controlplane import Store
+from kubeinfer_tpu.metrics import REGISTRY, reconcile_total
+from kubeinfer_tpu.utils.clock import SimulatedClock
+
+
+def mk_service(name="svc", replicas=2, gpu=1, policy="jax-greedy", **spec_over):
+    svc = LLMService()
+    svc.metadata.name = name
+    svc.spec = LLMServiceSpec(
+        model=f"org/{name}-model",
+        replicas=replicas,
+        gpu_per_replica=gpu,
+        scheduler_policy=SchedulerPolicy(policy),
+        **spec_over,
+    )
+    svc.validate()
+    return svc
+
+
+def mk_node(name, gpu=8, mem_gib=64, cached=(), heartbeat=0.0):
+    n = NodeState(
+        gpu_capacity=gpu,
+        gpu_free=gpu,
+        gpu_memory_bytes=int(mem_gib * 2**30),
+        gpu_memory_free_bytes=int(mem_gib * 2**30),
+        cached_models=list(cached),
+        heartbeat=heartbeat,
+    )
+    n.metadata.name = name
+    return n
+
+
+def setup(n_nodes=3, **node_kw):
+    store = Store()
+    clock = SimulatedClock(start=100.0)
+    for i in range(n_nodes):
+        store.create(NodeState.KIND, mk_node(f"node-{i}", **node_kw).to_dict())
+    return store, clock, Controller(store, clock=clock)
+
+
+class TestWorkloadLifecycle:
+    def test_creates_workload_with_env_contract(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        res = c.reconcile_once()
+        assert res.workloads_created == 1
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        # env parity with reference llmservice_controller.go:231-266
+        assert w.env["CONFIGMAP_NAME"] == "svc-cache"
+        assert w.env["MODEL_REPO"] == "org/svc-model"
+        assert w.env["MODEL_PATH"] == "/models"
+        assert w.cache_group == "svc-cache"
+        assert len(w.replicas) == 2
+
+    def test_replica_scale_up_and_down(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc", replicas=2).to_dict())
+        c.reconcile_once()
+
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        svc.spec.replicas = 5
+        store.update(LLMService.KIND, svc.to_dict())
+        c.reconcile_once()
+        assert len(Workload.from_dict(store.get(Workload.KIND, "svc")).replicas) == 5
+
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        svc.spec.replicas = 1
+        store.update(LLMService.KIND, svc.to_dict())
+        c.reconcile_once()
+        assert len(Workload.from_dict(store.get(Workload.KIND, "svc")).replicas) == 1
+
+    def test_model_change_restarts_replicas(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        c.reconcile_once()
+        # simulate agent bringing replicas up
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        for r in w.replicas:
+            r.phase = "Ready"
+        store.update(Workload.KIND, w.to_dict())
+
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        svc.spec.model = "org/new-model"
+        store.update(LLMService.KIND, svc.to_dict())
+        c.reconcile_once()
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        assert w.model_repo == "org/new-model"
+        assert all(r.phase in ("Starting", "Pending") for r in w.replicas)
+
+    def test_deleted_service_garbage_collects_workload(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        c.reconcile_once()
+        store.delete(LLMService.KIND, "svc")
+        res = c.reconcile_once()
+        assert res.workloads_deleted == 1
+        assert store.list(Workload.KIND) == []
+
+    def test_workload_recreated_if_deleted(self):
+        """Owns semantics: a deleted owned object is re-created
+        (llmservice_controller.go:316-320 + 111-129)."""
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        c.reconcile_once()
+        store.delete(Workload.KIND, "svc")
+        res = c.reconcile_once()
+        assert res.workloads_created == 1
+        assert store.get(Workload.KIND, "svc")
+
+
+class TestPlacement:
+    def test_all_replicas_bound_when_capacity_exists(self):
+        store, clock, c = setup(n_nodes=2)
+        store.create(LLMService.KIND, mk_service("svc", replicas=4).to_dict())
+        res = c.reconcile_once()
+        assert res.replicas_placed == 4
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        assert all(r.node.startswith("node-") for r in w.replicas)
+        assert all(r.phase == "Starting" for r in w.replicas)
+
+    def test_no_nodes_leaves_pending(self):
+        store, clock, c = setup(n_nodes=0)
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        res = c.reconcile_once()
+        assert res.replicas_placed == 0
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        assert svc.status.phase == "Pending"
+
+    def test_stale_node_excluded(self):
+        store, clock, c = setup(n_nodes=0)
+        store.create(NodeState.KIND, mk_node("fresh", heartbeat=95.0).to_dict())
+        store.create(NodeState.KIND, mk_node("stale", heartbeat=10.0).to_dict())
+        store.create(LLMService.KIND, mk_service("svc", replicas=2).to_dict())
+        res = c.reconcile_once()
+        assert res.nodes == 1
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        assert all(r.node == "fresh" for r in w.replicas)
+
+    def test_capacity_respected_across_services(self):
+        store, clock, c = setup(n_nodes=1, gpu=4)
+        store.create(LLMService.KIND, mk_service("a", replicas=3, gpu=2).to_dict())
+        store.create(LLMService.KIND, mk_service("b", replicas=3, gpu=2).to_dict())
+        res = c.reconcile_once()
+        assert res.replicas_placed == 2  # 4 chips / 2 per replica
+
+    def test_priority_preempts_on_rescheduling(self):
+        """Config 4: a higher-priority service arriving later displaces a
+        lower-priority incumbent when capacity is scarce."""
+        store, clock, c = setup(n_nodes=1, gpu=2)
+        store.create(
+            LLMService.KIND, mk_service("low", replicas=1, gpu=2, priority=0).to_dict()
+        )
+        c.reconcile_once()
+        w_low = Workload.from_dict(store.get(Workload.KIND, "low"))
+        assert w_low.replicas[0].node == "node-0"
+
+        store.create(
+            LLMService.KIND,
+            mk_service("high", replicas=1, gpu=2, priority=10).to_dict(),
+        )
+        c.reconcile_once()
+        w_low = Workload.from_dict(store.get(Workload.KIND, "low"))
+        w_high = Workload.from_dict(store.get(Workload.KIND, "high"))
+        assert w_high.replicas[0].node == "node-0"
+        assert w_low.replicas[0].node == ""
+        assert w_low.replicas[0].phase == "Pending"
+
+    def test_hysteresis_keeps_placement_stable_across_ticks(self):
+        store, clock, c = setup(n_nodes=4)
+        store.create(LLMService.KIND, mk_service("svc", replicas=4).to_dict())
+        c.reconcile_once()
+        first = [
+            r.node
+            for r in Workload.from_dict(store.get(Workload.KIND, "svc")).replicas
+        ]
+        for _ in range(3):
+            c.reconcile_once()
+        after = [
+            r.node
+            for r in Workload.from_dict(store.get(Workload.KIND, "svc")).replicas
+        ]
+        assert first == after
+
+    def test_gang_all_or_nothing_across_reconcile(self):
+        store, clock, c = setup(n_nodes=1, gpu=4)
+        store.create(
+            LLMService.KIND,
+            mk_service("gang", replicas=3, gpu=2, gang=True).to_dict(),
+        )
+        res = c.reconcile_once()
+        assert res.replicas_placed == 0  # needs 6 chips, node has 4
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "gang"))
+        assert svc.status.phase == "Pending"
+
+    def test_native_policy_places_too(self):
+        store, clock, c = setup(n_nodes=2)
+        store.create(
+            LLMService.KIND,
+            mk_service("svc", replicas=3, policy="native-greedy").to_dict(),
+        )
+        res = c.reconcile_once()
+        assert res.replicas_placed == 3
+        assert "native-greedy" in res.solve_ms
+
+
+class TestStatus:
+    def test_status_phases_progress(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc", replicas=2).to_dict())
+        c.reconcile_once()
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        assert svc.status.phase == "Scheduling"
+
+        # agent marks one Ready
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        w.replicas[0].phase = "Ready"
+        store.update(Workload.KIND, w.to_dict())
+        c.reconcile_once()
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        assert svc.status.phase == "Degraded"
+        assert svc.status.available_replicas == 1
+
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        for r in w.replicas:
+            r.phase = "Ready"
+        store.update(Workload.KIND, w.to_dict())
+        c.reconcile_once()
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        assert svc.status.phase == "Running"
+        assert svc.status.get_condition("Available").status == "True"
+        assert svc.status.placements and all(svc.status.placements)
+
+    def test_cache_coordinator_from_lease(self):
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        store.create(
+            "Lease",
+            {
+                "metadata": {"name": "svc-cache-lease"},
+                "spec": {"holderIdentity": "svc-pod-1"},
+            },
+        )
+        c.reconcile_once()
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "svc"))
+        assert svc.status.cache_coordinator == "svc-pod-1"
+
+    def test_reconcile_metrics_recorded(self):
+        REGISTRY.reset()
+        store, clock, c = setup()
+        store.create(LLMService.KIND, mk_service("svc").to_dict())
+        c.reconcile_once()
+        assert reconcile_total.value("llmservice", "success") == 1
+        rendered = REGISTRY.render()
+        assert "kubeinfer_solve_duration_seconds_bucket" in rendered
+        assert 'kubeinfer_llmservice_total 1' in rendered
+
+
+class TestCrossPolicyCapacity:
+    def test_policy_groups_do_not_double_book(self):
+        """Regression: each policy group's solve must see capacity already
+        consumed by other groups' placements in the same tick."""
+        store, clock, c = setup(n_nodes=1, gpu=8)
+        store.create(
+            LLMService.KIND,
+            mk_service("a", replicas=2, gpu=3, policy="jax-greedy").to_dict(),
+        )
+        store.create(
+            LLMService.KIND,
+            mk_service("b", replicas=2, gpu=3, policy="native-greedy").to_dict(),
+        )
+        res = c.reconcile_once()
+        assert res.replicas_placed == 2  # 8 chips / 3 per replica = 2 fit
+        total_gpu = 0
+        for name in ("a", "b"):
+            w = Workload.from_dict(store.get(Workload.KIND, name))
+            total_gpu += sum(3 for r in w.replicas if r.node)
+        assert total_gpu <= 8
+
+    def test_high_priority_group_solves_first(self):
+        store, clock, c = setup(n_nodes=1, gpu=4)
+        store.create(
+            LLMService.KIND,
+            mk_service("low", replicas=1, gpu=4, policy="jax-greedy",
+                       priority=0).to_dict(),
+        )
+        store.create(
+            LLMService.KIND,
+            mk_service("high", replicas=1, gpu=4, policy="native-greedy",
+                       priority=50).to_dict(),
+        )
+        c.reconcile_once()
+        w_high = Workload.from_dict(store.get(Workload.KIND, "high"))
+        w_low = Workload.from_dict(store.get(Workload.KIND, "low"))
+        assert w_high.replicas[0].node == "node-0"
+        assert w_low.replicas[0].node == ""

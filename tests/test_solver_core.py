@@ -301,3 +301,27 @@ class TestZeroNoiseSpreading:
         )
         out = solve_greedy(p, ScoreWeights(noise=0.0))
         assert int(out.placed) == 160  # all capacity used (40 nodes x 4)
+
+
+class TestPriorityGating:
+    def test_high_priority_wins_node_discovered_late(self):
+        """Regression: without priority-gated rounds, low-priority jobs
+        commit capacity in round 1 on the one node a high-priority job only
+        reaches in round 2 (after losing its first-choice conflict)."""
+        import numpy as np
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        # 4 nodes of 8 chips. 4 high-prio jobs of 6 chips (must take one
+        # node each) + 3 low-prio jobs of 4 chips (fit only if they get a
+        # whole node, which they must NOT).
+        p = encode_problem_arrays(
+            job_gpu=np.array([6, 6, 6, 6, 4, 4, 4], np.float32),
+            job_mem_gib=np.zeros(7, np.float32),
+            job_priority=np.array([100, 100, 100, 100, 0, 0, 0], np.float32),
+            node_gpu_free=np.full(4, 8.0, np.float32),
+            node_mem_free_gib=np.full(4, 64.0, np.float32),
+        )
+        a = solve_greedy(p)
+        nodes = np.asarray(a.node)
+        assert (nodes[:4] >= 0).all(), nodes
+        assert (nodes[4:] == -1).all(), nodes
