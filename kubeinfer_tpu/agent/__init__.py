@@ -1,0 +1,34 @@
+"""Agent plane: per-node and per-replica runtime daemons.
+
+Parity targets (reference internal/agent/*, cmd/agent/main.go):
+
+- Lease election with coordinator/follower role flips (election.go via
+  kubeinfer_tpu.coordination).
+- Coordinator: ensure model present (download once), serve it over HTTP
+  (coordinator.go, model_server.go).
+- Follower: pull model files from the coordinator instead of the WAN
+  (follower.go) — extended with resumable, subdirectory-safe transfers
+  (both called out as reference gaps: follower.go:117-149 "no retry/
+  resume", SURVEY.md §2 #9 flat-file-only).
+- Inference runtime lifecycle: spawn/configure/stop the serving process
+  (vllm.go).
+- NEW duty (north star): agents report node-state vectors (NodeState) that
+  feed the solver's node tensor, and act as the kubelet-equivalent that
+  starts replica agents for workload replicas bound to their node.
+"""
+
+from kubeinfer_tpu.agent.runtime import RuntimeConfig, RuntimeServer
+from kubeinfer_tpu.agent.model_server import ModelServer
+from kubeinfer_tpu.agent.coordinator import Coordinator
+from kubeinfer_tpu.agent.follower import Follower
+from kubeinfer_tpu.agent.node_agent import NodeAgent, ReplicaAgent
+
+__all__ = [
+    "Coordinator",
+    "Follower",
+    "ModelServer",
+    "NodeAgent",
+    "ReplicaAgent",
+    "RuntimeConfig",
+    "RuntimeServer",
+]

@@ -1,0 +1,73 @@
+"""Follower role: sync the model from the coordinator, run the runtime.
+
+Parity: reference internal/agent/follower/follower.go:24-150 — ``Run``:
+GET coordinator /models list → download each file → start runtime → block.
+Transfers are resumable and subdirectory-safe (reference gaps; see
+transfer.py). Download duration feeds the
+kubeinfer_model_download_duration_seconds{source="coordinator"} histogram —
+the intra-cluster number whose ratio to the hub number substantiates the
+reference's aspirational "10-100x faster than WAN" claim
+(docs/PROJECT_ROADMAP.md:62).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from kubeinfer_tpu import metrics
+from kubeinfer_tpu.agent.model_server import ensure_model_dir
+from kubeinfer_tpu.agent.runtime import RuntimeConfig, RuntimeServer
+from kubeinfer_tpu.agent.transfer import sync_model
+
+log = logging.getLogger(__name__)
+
+
+class Follower:
+    """One follower per non-coordinator replica of a cache group."""
+
+    def __init__(
+        self,
+        coordinator_endpoint: str | Callable[[], str],
+        model_path: str,
+        runtime_config: RuntimeConfig | None = None,
+        start_runtime: bool = True,
+        sync_attempts: int = 5,
+    ) -> None:
+        self._endpoint = coordinator_endpoint
+        self.model_path = model_path
+        self._runtime_config = runtime_config
+        self._start_runtime = start_runtime
+        self._sync_attempts = sync_attempts
+        self.runtime: RuntimeServer | None = None
+        self._ready = threading.Event()
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        return self._ready.wait(timeout)
+
+    def sync(self) -> None:
+        """Pull model files from the coordinator (follower.go:52-63)."""
+        if ensure_model_dir(self.model_path):
+            log.info("model cache hit at %s", self.model_path)
+            return
+        t0 = time.perf_counter()
+        sync_model(self._endpoint, self.model_path, attempts=self._sync_attempts)
+        metrics.model_download_duration_seconds.observe(
+            "coordinator", time.perf_counter() - t0
+        )
+
+    def start_serving(self) -> None:
+        """Start the runtime once the model is in place."""
+        if self._start_runtime:
+            self.runtime = RuntimeServer(
+                self._runtime_config or RuntimeConfig(model_path=self.model_path)
+            )
+            self.runtime.start()  # follower.go:65-69
+        self._ready.set()
+
+    def shutdown(self) -> None:
+        if self.runtime is not None:
+            self.runtime.stop()
+            self.runtime = None

@@ -1,0 +1,430 @@
+"""Node agent (kubelet-equivalent) and replica agent (per-pod agent).
+
+``ReplicaAgent`` is the parity port of the reference's per-pod agent binary
+(cmd/agent/main.go:32-201): it joins the cache group's lease election and
+flips between Coordinator and Follower roles; the coordinator endpoint is
+resolved lease-holder → replica pod record, mirroring getCoordinatorIP's
+HolderIdentity → Pod IP lookup (main.go:175-201). With
+``cacheStrategy: none`` there is no election: every replica downloads from
+the hub itself (the reference declares the field but never reads it —
+SURVEY.md §0; this is its documented intent).
+
+``NodeAgent`` has no reference counterpart — it covers the duties the
+reference delegates to kubelet plus the north star's new requirement:
+**report node-state vectors** (NodeState heartbeats with capacity /
+free / cached-model data) that feed the solver's node tensor, and start/
+stop ReplicaAgents for workload replicas the solver binds to its node.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+import threading
+from typing import Callable
+
+from kubeinfer_tpu import metrics
+from kubeinfer_tpu.agent.coordinator import Coordinator, hub_download
+from kubeinfer_tpu.agent.follower import Follower
+from kubeinfer_tpu.agent.model_server import ensure_model_dir
+from kubeinfer_tpu.agent.runtime import RuntimeConfig
+from kubeinfer_tpu.api.workload import NodeState, Workload
+from kubeinfer_tpu.controlplane.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+from kubeinfer_tpu.coordination.lease import LeaseManager
+from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+log = logging.getLogger(__name__)
+
+
+def model_cache_dir(root: str, model_repo: str) -> str:
+    """Node-local cache dir for a model; replicas of the same model on one
+    node share it (that sharing IS the cache the reference builds)."""
+    return str(pathlib.Path(root) / model_repo.replace("/", "--"))
+
+
+class ReplicaAgent:
+    """One workload replica's agent process."""
+
+    def __init__(
+        self,
+        store: Store,
+        workload_name: str,
+        namespace: str,
+        replica_index: int,
+        node_name: str,
+        model_root: str,
+        clock: Clock | None = None,
+        downloader: Callable[[str, str], None] = hub_download,
+        runtime_config: RuntimeConfig | None = None,
+        start_runtime: bool = False,
+        lease_timings: tuple[float, float, float] | None = None,
+    ) -> None:
+        self._store = store
+        self._workload = workload_name
+        self._ns = namespace
+        self._index = replica_index
+        self._node = node_name
+        self._model_root = model_root
+        self._clock = clock or RealClock()
+        self._downloader = downloader
+        self._runtime_config = runtime_config
+        self._start_runtime = start_runtime
+        self._lease_timings = lease_timings
+        # pod-name analogue; also the lease holder identity
+        self.identity = f"{workload_name}-{replica_index}"
+        self._lease: LeaseManager | None = None
+        self._role_stop: threading.Event | None = None
+        self._role_thread: threading.Thread | None = None
+        self._supervisor: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self.model_repo = ""
+        self.cache_shared = False
+
+    # -- workload record I/O ------------------------------------------------
+
+    def _read_workload(self) -> Workload:
+        return Workload.from_dict(
+            self._store.get(Workload.KIND, self._workload, self._ns)
+        )
+
+    def _patch_replica(self, phase: str | None = None, pod_ip: str | None = None) -> None:
+        """Read-modify-write only this replica's runtime fields."""
+        for _ in range(5):
+            try:
+                w = self._read_workload()
+            except NotFoundError:
+                return
+            for r in w.replicas:
+                if r.index == self._index:
+                    if r.node != self._node:
+                        return  # rebound elsewhere; not ours anymore
+                    if phase is not None:
+                        r.phase = phase
+                    if pod_ip is not None:
+                        r.pod_ip = pod_ip
+                    r.pod_name = self.identity
+                    break
+            else:
+                return
+            try:
+                self._store.update(Workload.KIND, w.to_dict())
+                return
+            except ConflictError:
+                continue
+        log.warning("%s: replica patch kept conflicting", self.identity)
+
+    def _resolve_coordinator(self) -> str:
+        """Lease holder → that replica's published endpoint
+        (getCoordinatorIP parity, cmd/agent/main.go:175-201)."""
+        if self._lease is None:
+            return ""
+        holder = self._lease.get_holder()
+        if not holder or holder == self.identity:
+            return ""
+        try:
+            w = self._read_workload()
+        except NotFoundError:
+            return ""
+        for r in w.replicas:
+            if r.pod_name == holder and r.pod_ip:
+                return r.pod_ip
+        return ""
+
+    # -- role management ----------------------------------------------------
+
+    def _stop_role(self) -> None:
+        if self._role_stop is not None:
+            self._role_stop.set()
+        if self._role_thread is not None:
+            self._role_thread.join(timeout=10)
+        self._role_stop = None
+        self._role_thread = None
+
+    def _spawn(self, target, name: str) -> threading.Event:
+        stop = threading.Event()
+        t = threading.Thread(target=target, args=(stop,), daemon=True, name=name)
+        self._role_stop = stop
+        self._role_thread = t
+        t.start()
+        return stop
+
+    def _become_coordinator(self) -> None:
+        if self._stopped.is_set():
+            # A clean lease surrender during stop() fires role callbacks;
+            # a dying agent must not spawn roles or patch the store.
+            return
+        metrics.coordinator_elections_total.inc(self._ns, self._lease_name())
+        self._stop_role()
+        self._patch_replica(phase="Starting")
+        coord = Coordinator(
+            model_repo=self.model_repo,
+            model_path=model_cache_dir(self._model_root, self.model_repo),
+            runtime_config=self._runtime_config,
+            downloader=self._downloader,
+            start_runtime=self._start_runtime,
+        )
+
+        def body(stop: threading.Event) -> None:
+            try:
+                coord.run_prepare()
+            except Exception:
+                log.exception("%s: coordinator prepare failed", self.identity)
+                self._patch_replica(phase="Failed")
+                return
+            self._patch_replica(phase="Ready", pod_ip=coord.endpoint)
+            stop.wait()
+            coord.shutdown()
+
+        self._spawn(body, f"coordinator-{self.identity}")
+
+    def _become_follower(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stop_role()
+        self._patch_replica(phase="Starting")
+        follower = Follower(
+            coordinator_endpoint=self._resolve_coordinator,
+            model_path=model_cache_dir(self._model_root, self.model_repo),
+            runtime_config=self._runtime_config,
+            start_runtime=self._start_runtime,
+        )
+
+        def body(stop: threading.Event) -> None:
+            # The coordinator may still be downloading from the hub for
+            # minutes before it publishes an endpoint; keep retrying until
+            # the role is torn down rather than failing the replica.
+            while not stop.is_set():
+                try:
+                    follower.sync()
+                    break
+                except Exception as e:
+                    log.warning("%s: follower sync not ready: %s", self.identity, e)
+                    if stop.wait(1.0):
+                        return
+            if stop.is_set():
+                return
+            follower.start_serving()
+            self._patch_replica(phase="Ready")
+            stop.wait()
+            follower.shutdown()
+
+        self._spawn(body, f"follower-{self.identity}")
+
+    def _become_solo(self) -> None:
+        """cacheStrategy none: no election, direct hub download, no model
+        server."""
+        if self._stopped.is_set():
+            return
+        self._stop_role()
+        self._patch_replica(phase="Starting")
+        coord = Coordinator(
+            model_repo=self.model_repo,
+            model_path=model_cache_dir(self._model_root, self.model_repo),
+            runtime_config=self._runtime_config,
+            downloader=self._downloader,
+            start_runtime=self._start_runtime,
+            serve_model=False,
+        )
+
+        def body(stop: threading.Event) -> None:
+            try:
+                coord.run_prepare()
+            except Exception:
+                log.exception("%s: model download failed", self.identity)
+                self._patch_replica(phase="Failed")
+                return
+            self._patch_replica(phase="Ready")
+            stop.wait()
+            coord.shutdown()
+
+        self._spawn(body, f"solo-{self.identity}")
+
+    def _lease_name(self) -> str:
+        # lease name derives from the cache group exactly like
+        # cmd/agent/main.go:72 derives it from CONFIGMAP_NAME
+        return f"{self._cache_group}-lease"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        w = self._read_workload()
+        self.model_repo = w.model_repo
+        self.cache_shared = w.cache_shared
+        self._cache_group = w.cache_group
+        if self.cache_shared:
+            timing_kw = {}
+            if self._lease_timings is not None:
+                d, rn, rt = self._lease_timings
+                timing_kw = dict(
+                    duration_s=d, renew_interval_s=rn, retry_interval_s=rt
+                )
+            self._lease = LeaseManager(
+                self._store,
+                self._ns,
+                self._lease_name(),
+                self.identity,
+                clock=self._clock,
+                **timing_kw,
+            )
+            self._lease.start(self._become_coordinator, self._become_follower)
+        else:
+            self._become_solo()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._lease is not None:
+            self._lease.stop()
+        self._stop_role()
+
+
+class NodeAgent:
+    """Per-node daemon: heartbeats NodeState, runs ReplicaAgents for
+    replicas the solver binds to this node."""
+
+    def __init__(
+        self,
+        store: Store,
+        node_name: str,
+        gpu_capacity: float,
+        gpu_memory_bytes: int,
+        model_root: str,
+        topology: tuple[int, int] = (0, 0),
+        clock: Clock | None = None,
+        heartbeat_interval_s: float = 10.0,
+        downloader: Callable[[str, str], None] = hub_download,
+        start_runtimes: bool = False,
+        lease_timings: tuple[float, float, float] | None = None,
+    ) -> None:
+        self._store = store
+        self.node_name = node_name
+        self._gpu_capacity = gpu_capacity
+        self._mem_capacity = gpu_memory_bytes
+        self._model_root = model_root
+        self._topology = topology
+        self._clock = clock or RealClock()
+        self._interval = heartbeat_interval_s
+        self._downloader = downloader
+        self._start_runtimes = start_runtimes
+        self._lease_timings = lease_timings
+        self._agents: dict[tuple[str, str, int], ReplicaAgent] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- node-state reporting ----------------------------------------------
+
+    def _cached_models(self) -> list[str]:
+        root = pathlib.Path(self._model_root)
+        if not root.exists():
+            return []
+        out = []
+        for d in sorted(root.iterdir()):
+            if d.is_dir() and ensure_model_dir(str(d)):
+                out.append(d.name.replace("--", "/"))
+        return out
+
+    def _bound_demand(self, workloads: list[Workload]) -> tuple[float, float]:
+        gpu = mem = 0.0
+        for w in workloads:
+            for r in w.replicas:
+                if r.node == self.node_name:
+                    gpu += w.gpu_per_replica
+                    mem += w.gpu_memory_bytes
+        return gpu, mem
+
+    def heartbeat(self, workloads: list[Workload]) -> None:
+        gpu_used, mem_used = self._bound_demand(workloads)
+        state = NodeState(
+            gpu_capacity=self._gpu_capacity,
+            gpu_free=max(self._gpu_capacity - gpu_used, 0.0),
+            gpu_memory_bytes=self._mem_capacity,
+            gpu_memory_free_bytes=max(int(self._mem_capacity - mem_used), 0),
+            topology=self._topology,
+            cached_models=self._cached_models(),
+            ready=True,
+            heartbeat=self._clock.now(),
+        )
+        state.metadata.name = self.node_name
+        d = state.to_dict()
+        try:
+            cur = self._store.get(NodeState.KIND, self.node_name)
+            d["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            self._store.update(NodeState.KIND, d)
+        except NotFoundError:
+            try:
+                self._store.create(NodeState.KIND, d)
+            except AlreadyExistsError:
+                pass  # raced another registration; next beat updates
+        except ConflictError:
+            pass  # next beat wins
+
+    # -- replica reconciliation (the kubelet duty) --------------------------
+
+    def sync_replicas(self, workloads: list[Workload]) -> None:
+        want: dict[tuple[str, str, int], Workload] = {}
+        for w in workloads:
+            for r in w.replicas:
+                if r.node == self.node_name:
+                    want[(w.metadata.namespace, w.metadata.name, r.index)] = w
+
+        # stop agents for replicas unbound/rebound elsewhere or model change
+        for key, agent in list(self._agents.items()):
+            w = want.get(key)
+            if w is None or agent.model_repo != w.model_repo:
+                agent.stop()
+                del self._agents[key]
+
+        for key, w in want.items():
+            if key not in self._agents:
+                ns, name, index = key
+                agent = ReplicaAgent(
+                    self._store,
+                    workload_name=name,
+                    namespace=ns,
+                    replica_index=index,
+                    node_name=self.node_name,
+                    model_root=self._model_root,
+                    clock=self._clock,
+                    downloader=self._downloader,
+                    start_runtime=self._start_runtimes,
+                    lease_timings=self._lease_timings,
+                )
+                self._agents[key] = agent
+                agent.start()
+
+    # -- loop ---------------------------------------------------------------
+
+    def tick(self) -> None:
+        workloads = [
+            Workload.from_dict(d) for d in self._store.list(Workload.KIND)
+        ]
+        self.sync_replicas(workloads)
+        self.heartbeat(workloads)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                log.exception("node agent %s tick failed", self.node_name)
+            self._clock.wait(self._stop, self._interval)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self.run, daemon=True, name=f"node-agent-{self.node_name}"
+        )
+        self._thread = t
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for agent in self._agents.values():
+            agent.stop()
+        self._agents.clear()

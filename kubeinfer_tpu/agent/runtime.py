@@ -1,0 +1,121 @@
+"""Inference runtime (vLLM) process lifecycle.
+
+Parity: reference internal/agent/vllm/vllm.go:13-143 — config struct with
+env overrides, CLI arg construction, subprocess start/wait/SIGTERM-stop.
+Defaults match vllm.go:34-43 (:8000, TP=1, gpu-mem-util 0.9, dtype auto);
+env override names keep the VLLM_ prefix so reference deployments port.
+
+The launch command is templated (``command_prefix``) so tests run a mock
+server (port of test/testdata/vllm-mock/mock_server.py) and TPU deployments
+can swap in a JAX-native serving entrypoint without touching lifecycle code.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RuntimeConfig:
+    """vllm.go:13-31 Config parity."""
+
+    model_path: str = "/models"
+    host: str = "0.0.0.0"
+    port: int = 8000
+    tensor_parallel_size: int = 1
+    gpu_memory_utilization: float = 0.9
+    max_model_len: int = 0  # 0 = server default
+    dtype: str = "auto"
+    extra_args: list[str] = field(default_factory=list)
+    # Not in the reference: the executable to wrap. Defaults to the vLLM
+    # OpenAI server exactly like vllm.go:95; tests override.
+    command_prefix: list[str] = field(
+        default_factory=lambda: [
+            sys.executable, "-m", "vllm.entrypoints.openai.api_server",
+        ]
+    )
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "RuntimeConfig":
+        """vllm.go:46-80 LoadConfigFromEnv parity (VLLM_* family)."""
+        e = os.environ if env is None else env
+        cfg = cls()
+        cfg.model_path = e.get("MODEL_PATH", cfg.model_path)
+        cfg.host = e.get("VLLM_HOST", cfg.host)
+        cfg.port = int(e.get("VLLM_PORT", cfg.port))
+        cfg.tensor_parallel_size = int(
+            e.get("VLLM_TENSOR_PARALLEL_SIZE", cfg.tensor_parallel_size)
+        )
+        cfg.gpu_memory_utilization = float(
+            e.get("VLLM_GPU_MEMORY_UTILIZATION", cfg.gpu_memory_utilization)
+        )
+        cfg.max_model_len = int(e.get("VLLM_MAX_MODEL_LEN", cfg.max_model_len))
+        cfg.dtype = e.get("VLLM_DTYPE", cfg.dtype)
+        extra = e.get("VLLM_EXTRA_ARGS", "")
+        if extra:
+            cfg.extra_args = shlex.split(extra)
+        cmd = e.get("RUNTIME_COMMAND", "")
+        if cmd:
+            cfg.command_prefix = shlex.split(cmd)
+        return cfg
+
+    def build_args(self) -> list[str]:
+        """vllm.go:93-112 buildArgs parity."""
+        args = list(self.command_prefix) + [
+            "--model", self.model_path,
+            "--host", self.host,
+            "--port", str(self.port),
+            "--tensor-parallel-size", str(self.tensor_parallel_size),
+            "--gpu-memory-utilization", str(self.gpu_memory_utilization),
+            "--dtype", self.dtype,
+        ]
+        if self.max_model_len > 0:
+            args += ["--max-model-len", str(self.max_model_len)]
+        args += self.extra_args
+        return args
+
+
+class RuntimeServer:
+    """vllm.go:115-142 Server parity: Start / Wait / Stop(SIGTERM)."""
+
+    def __init__(self, config: RuntimeConfig):
+        self.config = config
+        self._proc: subprocess.Popen | None = None
+
+    def start(self) -> None:
+        if self._proc is not None:
+            raise RuntimeError("runtime already started")
+        self._proc = subprocess.Popen(
+            self.config.build_args(),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def pid(self) -> int | None:
+        return self._proc.pid if self._proc else None
+
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def wait(self, timeout: float | None = None) -> int:
+        if self._proc is None:
+            raise RuntimeError("runtime not started")
+        return self._proc.wait(timeout=timeout)
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        """SIGTERM, escalate to SIGKILL after the grace period
+        (vllm.go:137-142 sends SIGTERM only; the kill escalation prevents
+        a wedged server from leaking)."""
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        self._proc.send_signal(signal.SIGTERM)
+        try:
+            self._proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=5.0)

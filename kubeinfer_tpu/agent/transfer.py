@@ -1,0 +1,151 @@
+"""Resumable model-file transfer client (the follower's download path).
+
+Reference behavior (internal/agent/follower/follower.go:83-149): GET
+/models for the list, then GET /models/<file> → os.Create → io.Copy, flat
+paths, no retry, no resume. Both the nesting and resume gaps are fixed here:
+
+- files download to ``<name>.part`` and rename into place on completion, so
+  a crashed transfer is never mistaken for a cached file;
+- an existing .part resumes via a Range request from its current size;
+- nested relative paths are created with ``mkdir -p`` semantics;
+- per-file retry with bounded attempts (coordinator may be mid-failover);
+- completed sizes are validated against the server's Content-Length /
+  Content-Range total, so a stale partial resumed against a changed file is
+  rejected instead of silently appended. (Same-size content drift is not
+  detected — the listing protocol carries no checksums yet.)
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import pathlib
+import time
+import urllib.parse
+
+
+class TransferError(RuntimeError):
+    pass
+
+
+def _open(endpoint: str) -> tuple[http.client.HTTPConnection, str]:
+    u = urllib.parse.urlparse(endpoint)
+    if u.scheme != "http":
+        raise TransferError(f"unsupported scheme {u.scheme!r}")
+    return http.client.HTTPConnection(u.hostname, u.port, timeout=10), u.path.rstrip("/")
+
+
+def fetch_file_list(endpoint: str) -> list[str]:
+    """GET /models → relative paths (follower.go:83-110 parity)."""
+    conn, base = _open(endpoint)
+    try:
+        conn.request("GET", base + "/models")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise TransferError(f"/models returned {resp.status}")
+        body = resp.read().decode()
+    finally:
+        conn.close()
+    return [line for line in body.splitlines() if line.strip()]
+
+
+def download_file(
+    endpoint: str,
+    rel_path: str,
+    dest_dir: str,
+    chunk_size: int = 1 << 20,
+) -> int:
+    """Download one file with resume; returns bytes transferred this call."""
+    dest = pathlib.Path(dest_dir) / rel_path
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    part = dest.with_name(dest.name + ".part")
+
+    offset = part.stat().st_size if part.exists() else 0
+    conn, base = _open(endpoint)
+    transferred = 0
+    expected_total = -1
+    try:
+        headers = {"Range": f"bytes={offset}-"} if offset else {}
+        conn.request("GET", base + "/models/" + urllib.parse.quote(rel_path), headers=headers)
+        resp = conn.getresponse()
+        if resp.status == 200:
+            offset = 0  # server ignored the range; restart
+            cl = resp.getheader("Content-Length")
+            if cl is not None:
+                expected_total = int(cl)
+        elif resp.status == 206:
+            # "bytes <start>-<end>/<total>": the total is the CURRENT
+            # server's file size — a stale .part resumed against a changed
+            # file (post-failover, or a re-released model) is detected below
+            # instead of silently appending corrupt bytes.
+            cr = resp.getheader("Content-Range", "")
+            if "/" in cr:
+                expected_total = int(cr.rsplit("/", 1)[1])
+            if expected_total >= 0 and offset > expected_total:
+                part.unlink(missing_ok=True)
+                raise TransferError(
+                    f"{rel_path}: stale partial ({offset}B) exceeds current "
+                    f"file size ({expected_total}B); restarting"
+                )
+        else:
+            raise TransferError(f"/models/{rel_path} returned {resp.status}")
+        mode = "ab" if offset else "wb"
+        with open(part, mode) as f:
+            if offset:
+                f.seek(offset)
+            while True:
+                chunk = resp.read(chunk_size)
+                if not chunk:
+                    break
+                f.write(chunk)
+                transferred += len(chunk)
+    finally:
+        conn.close()
+    final_size = part.stat().st_size
+    if expected_total >= 0 and final_size != expected_total:
+        # short read (connection died) or size drift: keep the .part for
+        # resume only when it is a prefix-consistent short read
+        if final_size > expected_total:
+            part.unlink(missing_ok=True)
+        raise TransferError(
+            f"{rel_path}: got {final_size}B, expected {expected_total}B"
+        )
+    os.replace(part, dest)  # atomic completion marker
+    return transferred
+
+
+def sync_model(
+    endpoint,
+    dest_dir: str,
+    attempts: int = 5,
+    retry_delay_s: float = 0.5,
+    sleep=time.sleep,
+) -> list[str]:
+    """Full follower sync: list + download all, with per-attempt retry.
+
+    ``endpoint`` is a URL or a zero-arg callable returning one — the
+    callable form re-resolves the coordinator each attempt, so a
+    mid-transfer coordinator death (connection error / short read) resumes
+    against the NEW coordinator after failover, continuing from the .part
+    file's size.
+    """
+    resolve = endpoint if callable(endpoint) else (lambda: endpoint)
+    last: Exception | None = None
+    for attempt in range(attempts):
+        ep = ""
+        try:
+            ep = resolve()
+            if not ep:
+                raise TransferError("no coordinator endpoint available")
+            files = fetch_file_list(ep)
+            for rel in files:
+                dest = pathlib.Path(dest_dir) / rel
+                if dest.exists():
+                    continue  # already completed (rename is the marker)
+                download_file(ep, rel, dest_dir)
+            return files
+        except (TransferError, OSError, http.client.HTTPException) as e:
+            last = e
+            if attempt < attempts - 1:
+                sleep(retry_delay_s)
+    raise TransferError(f"sync from {ep or endpoint} failed after {attempts} attempts: {last}")

@@ -25,6 +25,7 @@ assignment changes is reset to Starting (its agent restarts the runtime).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 
@@ -42,6 +43,8 @@ from kubeinfer_tpu.controlplane.store import (
 from kubeinfer_tpu.scheduler import SolveRequest, get_backend
 from kubeinfer_tpu.solver.problem import GIB, MAX_MODELS
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+log = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "llmservice"  # reconcile_total{controller=...}
 NODE_HEARTBEAT_TTL_S = 30.0  # nodes silent longer than this are unschedulable
@@ -145,28 +148,37 @@ class Controller:
         return w
 
     def _update_workload(self, w: Workload) -> Workload:
-        """CAS write with one re-read retry (agents also write workloads)."""
-        try:
-            stored = self._store.update(Workload.KIND, w.to_dict())
-        except ConflictError:
-            fresh = Workload.from_dict(
-                self._store.get(Workload.KIND, w.metadata.name, w.metadata.namespace)
-            )
-            # Merge: the controller owns bindings and replica-set shape; the
-            # agents own runtime truth (phase/pod fields). Where our binding
-            # agrees with the fresh copy, adopt the agent's runtime fields —
-            # clobbering them with our pre-tick snapshot would un-Ready
-            # replicas that just came up.
-            fresh_by_index = {r.index: r for r in fresh.replicas}
-            for r in w.replicas:
-                fr = fresh_by_index.get(r.index)
-                if fr is not None and fr.node == r.node:
-                    r.phase = fr.phase
-                    r.pod_name = fr.pod_name
-                    r.pod_ip = fr.pod_ip
-            w.metadata.resource_version = fresh.metadata.resource_version
-            stored = self._store.update(Workload.KIND, w.to_dict())
-        return Workload.from_dict(stored)
+        """CAS write with merge-and-retry (agents also write workloads).
+
+        Merge semantics: the controller owns bindings and replica-set
+        shape; the agents own runtime truth (phase/pod fields). Where our
+        binding agrees with the fresh copy, adopt the agent's runtime
+        fields — clobbering them with our pre-tick snapshot would un-Ready
+        replicas that just came up. Agents patch continuously (role flips,
+        readiness), so a single retry is not enough under churn.
+        """
+        last: Exception | None = None
+        for _ in range(8):
+            try:
+                stored = self._store.update(Workload.KIND, w.to_dict())
+                return Workload.from_dict(stored)
+            except ConflictError as e:
+                last = e
+                fresh = Workload.from_dict(
+                    self._store.get(
+                        Workload.KIND, w.metadata.name, w.metadata.namespace
+                    )
+                )
+                fresh_by_index = {r.index: r for r in fresh.replicas}
+                for r in w.replicas:
+                    fr = fresh_by_index.get(r.index)
+                    if fr is not None and fr.node == r.node:
+                        r.phase = fr.phase
+                        r.pod_name = fr.pod_name
+                        r.pod_ip = fr.pod_ip
+                w.metadata.resource_version = fresh.metadata.resource_version
+        assert last is not None
+        raise last
 
     # -- batched solve -----------------------------------------------------
 
@@ -408,7 +420,13 @@ class Controller:
         watch = self._store.watch()
         try:
             while not stop.is_set():
-                self.reconcile_once()
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    # A failed tick must not kill the control plane; the
+                    # next tick re-lists everything from scratch.
+                    log.exception("reconcile tick failed")
+                    metrics.reconcile_total.inc(CONTROLLER_NAME, "error")
                 watch.drain()
                 ev = watch.next_event(timeout=tick_interval_s)
                 if ev is not None:

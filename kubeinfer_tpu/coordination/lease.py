@@ -211,8 +211,13 @@ class LeaseManager:
 
         Ticks every retry interval when not leading (responsive takeover) and
         every renew interval when leading (bounded write QPS); fires
-        callbacks only on transitions.
+        callbacks only on transitions — plus one initial ``on_lost`` when
+        the first tick does NOT win, so a participant that never leads
+        still learns it is a follower and can start the follower role
+        (a flow the reference leaves implicit: its onLost only fires on
+        C→F transitions, cmd/agent/main.go:136-159).
         """
+        first = True
         while not self._stop.is_set():
             acquired = self.try_acquire_or_renew()
             with self._mu:
@@ -220,8 +225,9 @@ class LeaseManager:
                 self._is_leader = acquired
             if acquired and not was:
                 on_elected()
-            elif was and not acquired:
+            elif (was or first) and not acquired:
                 on_lost()
+            first = False
             interval = self._renew_interval / 2 if acquired else self._retry
             self._clock.wait(self._stop, interval)
         # On clean shutdown, surrender leadership state (the reference's

@@ -1,0 +1,224 @@
+"""Runtime launcher + model server + transfer layer tests.
+
+Covers the reference's vllm.go config/env/args behavior, model_server.go
+endpoints (plus recursive listing and Range, our gap-fixes), and the
+resumable transfer client with mid-transfer coordinator-death fault
+injection (a test the reference roadmap wished for but never had).
+"""
+
+import http.client
+import pathlib
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubeinfer_tpu.agent import ModelServer, RuntimeConfig, RuntimeServer
+from kubeinfer_tpu.agent.model_server import ensure_model_dir
+from kubeinfer_tpu.agent.transfer import (
+    TransferError,
+    download_file,
+    fetch_file_list,
+    sync_model,
+)
+
+TESTDATA = pathlib.Path(__file__).parent / "testdata"
+MOCK_CMD = [sys.executable, str(TESTDATA / "mock_inference_server.py")]
+
+
+class TestRuntimeConfig:
+    def test_defaults_match_reference(self):
+        # vllm.go:34-43
+        cfg = RuntimeConfig()
+        assert cfg.port == 8000
+        assert cfg.tensor_parallel_size == 1
+        assert cfg.gpu_memory_utilization == 0.9
+        assert cfg.dtype == "auto"
+
+    def test_env_overrides(self):
+        # vllm.go:46-80 VLLM_* family
+        cfg = RuntimeConfig.from_env(
+            {
+                "MODEL_PATH": "/m",
+                "VLLM_PORT": "9000",
+                "VLLM_TENSOR_PARALLEL_SIZE": "4",
+                "VLLM_GPU_MEMORY_UTILIZATION": "0.5",
+                "VLLM_MAX_MODEL_LEN": "8192",
+                "VLLM_DTYPE": "bfloat16",
+                "VLLM_EXTRA_ARGS": "--foo bar",
+            }
+        )
+        assert cfg.model_path == "/m"
+        assert cfg.port == 9000
+        assert cfg.tensor_parallel_size == 4
+        assert cfg.gpu_memory_utilization == 0.5
+        args = cfg.build_args()
+        assert args[-2:] == ["--foo", "bar"]
+        assert "--max-model-len" in args and "8192" in args
+
+    def test_max_model_len_omitted_when_zero(self):
+        # vllm.go:104-106
+        assert "--max-model-len" not in RuntimeConfig().build_args()
+
+
+class TestRuntimeServer:
+    def test_start_health_stop(self, tmp_path):
+        cfg = RuntimeConfig(
+            model_path=str(tmp_path), host="127.0.0.1", port=18731,
+            command_prefix=MOCK_CMD,
+        )
+        srv = RuntimeServer(cfg)
+        srv.start()
+        try:
+            deadline = time.time() + 10
+            body = None
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:18731/health", timeout=1
+                    ) as r:
+                        body = r.read()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            assert body and b"healthy" in body
+            assert srv.running()
+        finally:
+            srv.stop()
+        assert not srv.running()
+
+    def test_stop_before_start_is_noop(self):
+        RuntimeServer(RuntimeConfig()).stop()
+
+    def test_double_start_rejected(self, tmp_path):
+        cfg = RuntimeConfig(
+            command_prefix=[sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        srv = RuntimeServer(cfg)
+        srv.start()
+        try:
+            with pytest.raises(RuntimeError):
+                srv.start()
+        finally:
+            srv.stop()
+
+
+def make_model_dir(root: pathlib.Path) -> None:
+    (root / "config.json").write_bytes(b'{"arch": "test"}')
+    (root / "model-00001.safetensors").write_bytes(b"\x00" * 300_000)
+    sub = root / "tokenizer"
+    sub.mkdir()
+    (sub / "vocab.json").write_bytes(b'{"a": 1}')
+
+
+class TestModelServer:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        src = tmp_path / "models"
+        src.mkdir()
+        make_model_dir(src)
+        server = ModelServer(str(src), port=0)
+        server.start()
+        yield server, src
+        server.stop()
+
+    def test_health(self, served):
+        server, _ = served
+        with urllib.request.urlopen(server.endpoint + "/health") as r:
+            assert r.read() == b"OK"  # model_server.go:39-49
+
+    def test_recursive_listing(self, served):
+        server, _ = served
+        files = fetch_file_list(server.endpoint)
+        # nested path present (reference listed top level only)
+        assert "tokenizer/vocab.json" in files
+        assert "config.json" in files
+
+    def test_download_nested_file(self, served, tmp_path):
+        server, _ = served
+        dest = tmp_path / "dest"
+        n = download_file(server.endpoint, "tokenizer/vocab.json", str(dest))
+        assert n == len(b'{"a": 1}')
+        assert (dest / "tokenizer" / "vocab.json").read_bytes() == b'{"a": 1}'
+
+    def test_path_traversal_blocked(self, served):
+        server, _ = served
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        # raw request: urllib would normalize the dots away
+        conn.request("GET", "/models/../../etc/passwd")
+        assert conn.getresponse().status == 404  # model_server.go:88-100
+        conn.close()
+
+    def test_range_request_resumes(self, served, tmp_path):
+        server, src = served
+        full = (src / "model-00001.safetensors").read_bytes()
+        dest = tmp_path / "dest"
+        dest.mkdir()
+        part = dest / "model-00001.safetensors.part"
+        part.write_bytes(full[:100_000])  # simulate interrupted transfer
+        n = download_file(server.endpoint, "model-00001.safetensors", str(dest))
+        assert n == len(full) - 100_000  # only the tail was fetched
+        assert (dest / "model-00001.safetensors").read_bytes() == full
+
+
+class TestSyncModel:
+    def test_full_sync_and_cache_check(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        make_model_dir(src)
+        server = ModelServer(str(src), port=0)
+        server.start()
+        dest = tmp_path / "dest"
+        try:
+            files = sync_model(server.endpoint, str(dest))
+            assert len(files) == 3
+            assert (dest / "model-00001.safetensors").stat().st_size == 300_000
+            assert ensure_model_dir(str(dest))
+        finally:
+            server.stop()
+
+    def test_partial_dir_not_treated_as_cached(self, tmp_path):
+        d = tmp_path / "m"
+        d.mkdir()
+        (d / "weights.part").write_bytes(b"xx")
+        assert not ensure_model_dir(str(d))
+
+    def test_coordinator_death_mid_transfer_resumes_on_new_endpoint(self, tmp_path):
+        """Fault injection (SURVEY.md §7 hard part 6): kill the coordinator
+        after the follower got a partial file; a new coordinator comes up on
+        a different port; sync resumes from the .part offset."""
+        src = tmp_path / "src"
+        src.mkdir()
+        make_model_dir(src)
+        full = (src / "model-00001.safetensors").read_bytes()
+
+        dest = tmp_path / "dest"
+        dest.mkdir()
+        (dest / "config.json").write_bytes(b'{"arch": "test"}')  # done file
+        (dest / "model-00001.safetensors.part").write_bytes(full[:120_000])
+
+        server1 = ModelServer(str(src), port=0)  # the dying coordinator
+        server1.start()
+        server1.stop()  # dead before the follower reconnects
+
+        server2 = ModelServer(str(src), port=0)  # failover coordinator
+        server2.start()
+        endpoints = iter([server1.endpoint, server2.endpoint, server2.endpoint])
+        try:
+            files = sync_model(
+                lambda: next(endpoints), str(dest), attempts=3, retry_delay_s=0.01
+            )
+            assert len(files) == 3
+            assert (dest / "model-00001.safetensors").read_bytes() == full
+        finally:
+            server2.stop()
+
+    def test_sync_fails_after_attempts_exhausted(self, tmp_path):
+        with pytest.raises(TransferError):
+            sync_model(
+                "http://127.0.0.1:1/",  # nothing listens
+                str(tmp_path / "dest"),
+                attempts=2,
+                retry_delay_s=0.01,
+            )

@@ -1,0 +1,171 @@
+"""End-to-end slice (SURVEY.md §7): every layer live, in-process.
+
+Sample CR (3 replicas, shared cache — mirroring the reference's
+config/samples/ai_v1_llmservice_cache.yaml) → batched reconciler → JAX
+solver placements → workload bindings → node agents spawn replica agents →
+lease election → coordinator fabricates the model dir once and serves it →
+followers sync over HTTP → replicas Ready → status Running. Then the
+failure paths: coordinator kill (failover) and CR deletion (GC).
+"""
+
+import pathlib
+import threading
+import time
+
+import pytest
+
+from kubeinfer_tpu.agent import NodeAgent
+from kubeinfer_tpu.api.types import (
+    CacheStrategy,
+    LLMService,
+    LLMServiceSpec,
+    SchedulerPolicy,
+)
+from kubeinfer_tpu.api.workload import Workload
+from kubeinfer_tpu.controller import Controller
+from kubeinfer_tpu.controlplane import Store
+from kubeinfer_tpu.metrics import REGISTRY
+
+FAST_LEASE = (1.5, 1.0, 0.1)
+
+
+def fab_downloader(calls):
+    def download(repo, path):
+        calls.append(repo)
+        p = pathlib.Path(path)
+        p.mkdir(parents=True, exist_ok=True)
+        (p / "config.json").write_bytes(b"{}")
+        (p / "weights.bin").write_bytes(b"\x02" * 200_000)
+
+    return download
+
+
+def wait_until(pred, timeout=60.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """3-node cluster with controller + node agents running as threads."""
+    store = Store()
+    calls: list[str] = []
+    controller = Controller(store)
+    stop = threading.Event()
+    ctrl_thread = threading.Thread(
+        target=controller.run, args=(stop,), kwargs={"tick_interval_s": 0.2},
+        daemon=True,
+    )
+    agents = [
+        NodeAgent(
+            store,
+            f"node-{i}",
+            gpu_capacity=4,
+            gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path / f"node-{i}"),
+            downloader=fab_downloader(calls),
+            heartbeat_interval_s=0.2,
+            lease_timings=FAST_LEASE,
+        )
+        for i in range(3)
+    ]
+    for a in agents:
+        a.start()
+    ctrl_thread.start()
+    yield store, calls, agents
+    stop.set()
+    for a in agents:
+        a.stop()
+    ctrl_thread.join(timeout=10)
+
+
+def sample_cr() -> LLMService:
+    """config/samples/ai_v1_llmservice_cache.yaml: 3 replicas, shared."""
+    svc = LLMService()
+    svc.metadata.name = "deepseek-cache"
+    svc.spec = LLMServiceSpec(
+        model="deepseek-ai/deepseek-r1-distill",
+        replicas=3,
+        gpu_per_replica=2,
+        cache_strategy=CacheStrategy.SHARED,
+        gpu_memory="16Gi",
+        scheduler_policy=SchedulerPolicy.JAX_GREEDY,
+    )
+    svc.validate()
+    return svc
+
+
+class TestEndToEndSlice:
+    def test_cr_to_running_with_single_hub_download(self, cluster):
+        store, calls, agents = cluster
+        store.create(LLMService.KIND, sample_cr().to_dict())
+
+        def running():
+            svc = LLMService.from_dict(store.get(LLMService.KIND, "deepseek-cache"))
+            return svc.status.phase == "Running"
+
+        assert wait_until(running), LLMService.from_dict(
+            store.get(LLMService.KIND, "deepseek-cache")
+        ).to_dict()
+
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "deepseek-cache"))
+        assert svc.status.available_replicas == 3
+        assert len([p for p in svc.status.placements if p]) == 3
+        assert svc.status.cache_coordinator.startswith("deepseek-cache-")
+        assert svc.status.get_condition("Available").status == "True"
+        # shared cache did its job: exactly one WAN download for 3 replicas
+        assert calls == ["deepseek-ai/deepseek-r1-distill"]
+        # metrics flowed end to end
+        text = REGISTRY.render()
+        assert 'kubeinfer_model_download_duration_seconds_count{source="hub"}' in text
+        assert 'source="coordinator"' in text or calls.count(
+            "deepseek-ai/deepseek-r1-distill"
+        ) == 1
+
+    def test_coordinator_node_failure_recovers(self, cluster):
+        store, calls, agents = cluster
+        store.create(LLMService.KIND, sample_cr().to_dict())
+
+        def running():
+            svc = LLMService.from_dict(store.get(LLMService.KIND, "deepseek-cache"))
+            return svc.status.phase == "Running"
+
+        assert wait_until(running)
+        coordinator = LLMService.from_dict(
+            store.get(LLMService.KIND, "deepseek-cache")
+        ).status.cache_coordinator
+
+        # find and kill the node agent hosting the coordinator replica
+        w = Workload.from_dict(store.get(Workload.KIND, "deepseek-cache"))
+        coord_node = next(r.node for r in w.replicas if r.pod_name == coordinator)
+        victim = next(a for a in agents if a.node_name == coord_node)
+        victim.stop()
+
+        def new_coordinator_elected():
+            svc = LLMService.from_dict(store.get(LLMService.KIND, "deepseek-cache"))
+            return (
+                svc.status.cache_coordinator
+                and svc.status.cache_coordinator != coordinator
+            )
+
+        assert wait_until(new_coordinator_elected, timeout=30)
+
+    def test_cr_deletion_tears_everything_down(self, cluster):
+        store, calls, agents = cluster
+        store.create(LLMService.KIND, sample_cr().to_dict())
+        assert wait_until(
+            lambda: LLMService.from_dict(
+                store.get(LLMService.KIND, "deepseek-cache")
+            ).status.phase
+            == "Running"
+        )
+        store.delete(LLMService.KIND, "deepseek-cache")
+        assert wait_until(lambda: store.list(Workload.KIND) == [])
+        # node agents reap their replica agents on the next tick
+        assert wait_until(
+            lambda: all(len(a._agents) == 0 for a in agents), timeout=10
+        )

@@ -1,0 +1,304 @@
+"""Node/replica agent integration: election roles, model distribution
+between replica agents, node-state heartbeats, kubelet-style replica sync.
+
+Real threads + real HTTP on localhost; fast lease timings on a real clock
+(the deterministic election state machine itself is covered in
+test_election.py with SimulatedClock)."""
+
+import pathlib
+import time
+
+import pytest
+
+from kubeinfer_tpu.agent import NodeAgent, ReplicaAgent
+from kubeinfer_tpu.agent.node_agent import model_cache_dir
+from kubeinfer_tpu.api.workload import NodeState, ReplicaSpec, Workload
+from kubeinfer_tpu.controlplane import Store
+
+FAST_LEASE = (1.5, 1.0, 0.1)  # duration, renew, retry
+
+
+def fab_downloader(calls=None):
+    """Fabricate a model dir instead of hitting the hub."""
+
+    def download(repo: str, path: str) -> None:
+        if calls is not None:
+            calls.append(repo)
+        p = pathlib.Path(path)
+        p.mkdir(parents=True, exist_ok=True)
+        (p / "config.json").write_bytes(b'{"model": "%s"}' % repo.encode())
+        (p / "weights.bin").write_bytes(b"\x01" * 100_000)
+        sub = p / "tokenizer"
+        sub.mkdir(exist_ok=True)
+        (sub / "vocab.json").write_bytes(b"{}")
+
+    return download
+
+
+def mk_workload(store, name="svc", replicas=2, nodes=("node-a", "node-b"),
+                shared=True):
+    w = Workload(
+        owner=name,
+        image="img",
+        model_repo=f"org/{name}",
+        cache_group=f"{name}-cache",
+        cache_shared=shared,
+        gpu_per_replica=1,
+        gpu_memory_bytes=16 << 30,
+        replicas=[
+            ReplicaSpec(index=i, node=nodes[i % len(nodes)], phase="Starting")
+            for i in range(replicas)
+        ],
+    )
+    w.metadata.name = name
+    store.create(Workload.KIND, w.to_dict())
+    return w
+
+
+def wait_until(pred, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def phases(store, name="svc"):
+    w = Workload.from_dict(store.get(Workload.KIND, name))
+    return [r.phase for r in w.replicas]
+
+
+class TestReplicaAgentRoles:
+    def test_two_agents_elect_and_distribute(self, tmp_path):
+        """The core data-plane flow: two replicas on two nodes; one becomes
+        coordinator (downloads + serves), the other follows (syncs over
+        HTTP); both go Ready; coordinator publishes its endpoint."""
+        store = Store()
+        calls = []
+        mk_workload(store, "svc", replicas=2)
+        agents = [
+            ReplicaAgent(
+                store, "svc", "default", i, node,
+                model_root=str(tmp_path / node),
+                downloader=fab_downloader(calls),
+                lease_timings=FAST_LEASE,
+            )
+            for i, node in enumerate(["node-a", "node-b"])
+        ]
+        for a in agents:
+            a.start()
+        try:
+            assert wait_until(
+                lambda: phases(store) == ["Ready", "Ready"]
+            ), phases(store)
+            # exactly one hub download; the other replica synced over HTTP
+            assert len(calls) == 1
+            w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+            coord = [r for r in w.replicas if r.pod_ip]
+            assert len(coord) == 1 and coord[0].pod_ip.startswith("http://")
+            # follower's node has the model files on disk
+            follower_idx = 1 - coord[0].index
+            follower_node = ["node-a", "node-b"][follower_idx]
+            d = pathlib.Path(
+                model_cache_dir(str(tmp_path / follower_node), "org/svc")
+            )
+            assert (d / "weights.bin").stat().st_size == 100_000
+            assert (d / "tokenizer" / "vocab.json").exists()
+        finally:
+            for a in agents:
+                a.stop()
+
+    def test_coordinator_failover_promotes_follower(self, tmp_path):
+        store = Store()
+        mk_workload(store, "svc", replicas=2)
+        agents = [
+            ReplicaAgent(
+                store, "svc", "default", i, node,
+                model_root=str(tmp_path / node),
+                downloader=fab_downloader(),
+                lease_timings=FAST_LEASE,
+            )
+            for i, node in enumerate(["node-a", "node-b"])
+        ]
+        for a in agents:
+            a.start()
+        try:
+            assert wait_until(lambda: phases(store) == ["Ready", "Ready"])
+            w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+            coord_idx = next(r.index for r in w.replicas if r.pod_ip)
+            agents[coord_idx].stop()  # kill the coordinator agent
+
+            def new_coordinator():
+                w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+                other = w.replicas[1 - coord_idx]
+                lease = store.get("Lease", "svc-cache-lease")
+                return lease["spec"]["holderIdentity"] == other.pod_name
+
+            assert wait_until(new_coordinator, timeout=30)
+        finally:
+            for a in agents:
+                a.stop()
+
+    def test_cache_none_skips_election(self, tmp_path):
+        store = Store()
+        calls = []
+        mk_workload(store, "svc", replicas=2, shared=False)
+        agents = [
+            ReplicaAgent(
+                store, "svc", "default", i, node,
+                model_root=str(tmp_path / node),
+                downloader=fab_downloader(calls),
+                lease_timings=FAST_LEASE,
+            )
+            for i, node in enumerate(["node-a", "node-b"])
+        ]
+        for a in agents:
+            a.start()
+        try:
+            assert wait_until(lambda: phases(store) == ["Ready", "Ready"])
+            assert len(calls) == 2  # both hit the hub: no shared cache
+            with pytest.raises(KeyError):
+                store.get("Lease", "svc-cache-lease")
+        finally:
+            for a in agents:
+                a.stop()
+
+    def test_same_node_replicas_share_cache_dir(self, tmp_path):
+        store = Store()
+        calls = []
+        mk_workload(store, "svc", replicas=2, nodes=("node-a",))
+        agents = [
+            ReplicaAgent(
+                store, "svc", "default", i, "node-a",
+                model_root=str(tmp_path / "node-a"),
+                downloader=fab_downloader(calls),
+                lease_timings=FAST_LEASE,
+            )
+            for i in range(2)
+        ]
+        for a in agents:
+            a.start()
+        try:
+            assert wait_until(lambda: phases(store) == ["Ready", "Ready"])
+            assert len(calls) == 1  # second replica found the dir cached
+        finally:
+            for a in agents:
+                a.stop()
+
+
+class TestNodeAgent:
+    def test_heartbeat_reports_capacity_and_cache(self, tmp_path):
+        store = Store()
+        mk_workload(store, "svc", replicas=2, nodes=("node-a",))
+        fab_downloader()("org/already-cached", model_cache_dir(str(tmp_path), "org/already-cached"))
+        na = NodeAgent(
+            store, "node-a", gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path), downloader=fab_downloader(),
+            lease_timings=FAST_LEASE,
+        )
+        na.tick()
+        try:
+            state = NodeState.from_dict(store.get(NodeState.KIND, "node-a"))
+            assert state.gpu_capacity == 8
+            assert state.gpu_free == 6  # two bound replicas x 1 gpu
+            assert state.gpu_memory_free_bytes == 32 << 30
+            assert "org/already-cached" in state.cached_models
+            assert state.heartbeat > 0
+        finally:
+            na.stop()
+
+    def test_spawns_and_reaps_replica_agents(self, tmp_path):
+        store = Store()
+        mk_workload(store, "svc", replicas=2, nodes=("node-a", "node-b"))
+        na = NodeAgent(
+            store, "node-a", gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path), downloader=fab_downloader(),
+            lease_timings=FAST_LEASE,
+        )
+        try:
+            na.tick()
+            assert len(na._agents) == 1  # only replica 0 is on node-a
+            assert wait_until(lambda: phases(store)[0] == "Ready")
+
+            # rebind replica 0 elsewhere -> agent reaped
+            w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+            w.replicas[0].node = "node-b"
+            store.update(Workload.KIND, w.to_dict())
+            na.tick()
+            assert len(na._agents) == 0
+        finally:
+            na.stop()
+
+    def test_model_change_restarts_agent(self, tmp_path):
+        store = Store()
+        mk_workload(store, "svc", replicas=1, nodes=("node-a",))
+        na = NodeAgent(
+            store, "node-a", gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path), downloader=fab_downloader(),
+            lease_timings=FAST_LEASE,
+        )
+        try:
+            na.tick()
+            first = na._agents[("default", "svc", 0)]
+            assert wait_until(lambda: phases(store)[0] == "Ready")
+            w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+            w.model_repo = "org/other"
+            store.update(Workload.KIND, w.to_dict())
+            na.tick()
+            second = na._agents[("default", "svc", 0)]
+            assert second is not first
+            assert second.model_repo == "org/other"
+        finally:
+            na.stop()
+
+
+class TestReviewRegressions:
+    def test_follower_waits_out_slow_coordinator_download(self, tmp_path):
+        """The coordinator may take minutes on the hub download; followers
+        must keep retrying (phase Starting), not mark Failed."""
+        store = Store()
+        slow_fab = fab_downloader()
+
+        def slow_download(repo, path):
+            time.sleep(3.0)  # much longer than the follower's retry window
+            slow_fab(repo, path)
+
+        mk_workload(store, "svc", replicas=2)
+        agents = [
+            ReplicaAgent(
+                store, "svc", "default", i, node,
+                model_root=str(tmp_path / node),
+                downloader=slow_download,
+                lease_timings=FAST_LEASE,
+            )
+            for i, node in enumerate(["node-a", "node-b"])
+        ]
+        for a in agents:
+            a.start()
+        try:
+            assert wait_until(lambda: phases(store) == ["Ready", "Ready"], timeout=45)
+            assert "Failed" not in phases(store)
+        finally:
+            for a in agents:
+                a.stop()
+
+    def test_stopped_agent_does_not_resurrect_in_store(self, tmp_path):
+        """Stopping the coordinator agent must not leave a spurious Ready
+        patch behind (the clean lease surrender fires on_lost)."""
+        store = Store()
+        mk_workload(store, "svc", replicas=1, nodes=("node-a",))
+        agent = ReplicaAgent(
+            store, "svc", "default", 0, "node-a",
+            model_root=str(tmp_path), downloader=fab_downloader(),
+            lease_timings=FAST_LEASE,
+        )
+        agent.start()
+        assert wait_until(lambda: phases(store) == ["Ready"])
+        agent.stop()
+        # force the replica to a non-Ready phase; nothing may flip it back
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        w.replicas[0].phase = "Starting"
+        store.update(Workload.KIND, w.to_dict())
+        time.sleep(1.0)
+        assert phases(store) == ["Starting"]

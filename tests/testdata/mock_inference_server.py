@@ -1,0 +1,60 @@
+"""Mock inference server — stand-in for the vLLM OpenAI server in tests.
+
+Parity: reference test/testdata/vllm-mock/mock_server.py:1-37 (FastAPI
+/health + /v1/models + /), rewritten on stdlib http.server so the test
+image needs no extra dependencies. Accepts (and mostly ignores) the real
+server's CLI flags so RuntimeConfig.build_args() drives it unchanged.
+"""
+
+import argparse
+import http.server
+import json
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="mock")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    # accepted for CLI parity, unused:
+    p.add_argument("--tensor-parallel-size", default="1")
+    p.add_argument("--gpu-memory-utilization", default="0.9")
+    p.add_argument("--dtype", default="auto")
+    p.add_argument("--max-model-len", default="0")
+    args = p.parse_args()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, obj, status=200):
+            data = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._json({"status": "healthy"})  # mock_server.py:8-15
+            elif self.path == "/v1/models":
+                self._json(
+                    {  # OpenAI-style list, mock_server.py:17-29
+                        "object": "list",
+                        "data": [
+                            {"id": args.model, "object": "model", "owned_by": "mock"}
+                        ],
+                    }
+                )
+            elif self.path == "/":
+                self._json({"message": "mock vllm server"})  # :31-33
+            else:
+                self.send_error(404)
+
+    server = http.server.ThreadingHTTPServer((args.host, args.port), Handler)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
