@@ -327,22 +327,22 @@ class NodeAgent:
                 out.append(d.name.replace("--", "/"))
         return out
 
-    def _bound_demand(self, workloads: list[Workload]) -> tuple[float, float]:
-        gpu = mem = 0.0
-        for w in workloads:
-            for r in w.replicas:
-                if r.node == self.node_name:
-                    gpu += w.gpu_per_replica
-                    mem += w.gpu_memory_bytes
-        return gpu, mem
+    def heartbeat(self) -> None:
+        """Report node-state vectors for the solver.
 
-    def heartbeat(self, workloads: list[Workload]) -> None:
-        gpu_used, mem_used = self._bound_demand(workloads)
+        ``gpu_free`` is what the FRAMEWORK may allocate (capacity minus any
+        external/system usage — zero here), NOT net of the framework's own
+        bound replicas: the controller re-solves every placement from full
+        capacity each tick. Subtracting our own replicas would double-count
+        them and make incumbents look infeasible on their own node — the
+        solve then evicts them, the next heartbeat frees the capacity, and
+        placements oscillate.
+        """
         state = NodeState(
             gpu_capacity=self._gpu_capacity,
-            gpu_free=max(self._gpu_capacity - gpu_used, 0.0),
+            gpu_free=self._gpu_capacity,
             gpu_memory_bytes=self._mem_capacity,
-            gpu_memory_free_bytes=max(int(self._mem_capacity - mem_used), 0),
+            gpu_memory_free_bytes=self._mem_capacity,
             topology=self._topology,
             cached_models=self._cached_models(),
             ready=True,
@@ -403,7 +403,7 @@ class NodeAgent:
             Workload.from_dict(d) for d in self._store.list(Workload.KIND)
         ]
         self.sync_replicas(workloads)
-        self.heartbeat(workloads)
+        self.heartbeat()
 
     def run(self) -> None:
         while not self._stop.is_set():

@@ -130,6 +130,12 @@ class NodeState:
     (SURVEY.md §7 step 1): accelerator counts/memory, topology coordinates
     for affinity scoring (BASELINE.json config 5), and cached-model set for
     cache-affinity scoring.
+
+    ``gpu_free``/``gpu_memory_free_bytes`` mean "allocatable to this
+    framework" (capacity minus external/system usage). They must NOT be
+    reduced by the framework's own bound replicas: the controller
+    re-solves every placement from these values each tick, so
+    self-subtraction double-counts and destabilizes placements.
     """
 
     KIND = "Node"

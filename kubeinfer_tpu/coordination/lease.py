@@ -18,10 +18,11 @@ Differences (deliberate):
   is why its election logic has zero tests (SURVEY.md §4). With
   ``SimulatedClock`` the failover and split-brain paths are tested
   deterministically in milliseconds (tests/test_election.py).
-- The retry ticker is 2s like the reference's retry period; the reference
-  ticks every 2s regardless of holding (election.go:178), renewing early.
-  We renew when half the renew interval elapsed, cutting write QPS while
-  staying well inside the TTL.
+- The ticker runs at the 2s retry period in both roles, renewing on every
+  leader tick exactly like the reference (election.go:178). An earlier
+  draft renewed only at half the renew interval to cut write QPS; under
+  host CPU starvation that margin proved too thin (a delayed tick blows
+  the TTL and the fleet thrashes through steal/flip cycles).
 """
 
 from __future__ import annotations
@@ -209,8 +210,7 @@ class LeaseManager:
     ) -> None:
         """Blocking election loop; call ``stop()`` from another thread.
 
-        Ticks every retry interval when not leading (responsive takeover) and
-        every renew interval when leading (bounded write QPS); fires
+        Ticks every retry interval in both roles; fires
         callbacks only on transitions — plus one initial ``on_lost`` when
         the first tick does NOT win, so a participant that never leads
         still learns it is a follower and can start the follower role
@@ -228,8 +228,12 @@ class LeaseManager:
             elif (was or first) and not acquired:
                 on_lost()
             first = False
-            interval = self._renew_interval / 2 if acquired else self._retry
-            self._clock.wait(self._stop, interval)
+            # Tick at the retry interval in BOTH roles (election.go:178
+            # ticks leaders every 2s too). Renewing only near the renew
+            # deadline would cut write QPS, but it thins the starvation
+            # margin: on a loaded host a delayed renew tick blows the TTL
+            # and the fleet thrashes through steal/flip cycles.
+            self._clock.wait(self._stop, self._retry)
         # On clean shutdown, surrender leadership state (the reference's
         # context-cancel path just exits; peers take over on expiry).
         with self._mu:

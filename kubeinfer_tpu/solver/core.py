@@ -11,8 +11,9 @@ Two device-side algorithms, selected per job via ``schedulerPolicy``:
   still-unplaced job provably had no feasible node left. This is the
   TPU-shaped replacement for a serial first-fit loop: rounds are O(J*N)
   dense vector ops (VPU/HBM-friendly) instead of 10k sequential decisions.
-  Priority classes are released one per round (class k bids from round k,
-  see MAX_PRIORITY_CLASSES): per-node accept order alone can't stop a
+  Priority classes are released through a settlement gate (class k+1 bids
+  only once every class-<=k job is placed or bid-less, see
+  MAX_PRIORITY_CLASSES): per-node accept order alone can't stop a
   low-priority job from committing capacity on a node the high-priority
   class only discovers a round later.
 
@@ -48,12 +49,15 @@ _EPS = 1e-4  # capacity comparison slack for f32 fractional demands
 # max_rounds nodes and silently under-schedules); a 1e-3 perturbation is far
 # below any meaningful cost gap but keeps bids spread.
 _MIN_TIE_NOISE = 1e-3
-# Priority classes are released into the bidding one per round (class k may
-# bid from round k). Without this gating, low-priority jobs commit capacity
-# in round 1 on nodes a high-priority job only discovers in round 2 —
-# priority inversion under contention. Distinct priorities beyond this many
-# classes share the last class (accept order still ranks them per node).
-MAX_PRIORITY_CLASSES = 16
+# Priority classes are released into the bidding through a settlement gate:
+# class k+1 may bid only after every class-<=k job is placed or has no
+# feasible bid. Without this, low-priority jobs commit capacity on nodes a
+# high-priority job only discovers after losing a conflict — priority
+# inversion under contention. Distinct priorities are quantile-compressed
+# into at most this many classes: each class costs at least one extra
+# [J, N] round on the device, and the per-node accept order still ranks
+# exact priorities within a class.
+MAX_PRIORITY_CLASSES = 4
 
 
 @dataclass(frozen=True)
@@ -218,8 +222,8 @@ def solve_greedy(
     inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
     inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
 
-    # Dense priority rank (0 = highest priority class), clamped to
-    # MAX_PRIORITY_CLASSES. Class k joins the bidding at round k.
+    # Dense priority rank (0 = highest priority class), quantile-compressed
+    # to MAX_PRIORITY_CLASSES. Class k joins the bidding at round k.
     neg_p = jnp.where(jobs.valid, -jobs.priority, jnp.inf)
     order_p = jnp.argsort(neg_p)
     sorted_p = neg_p[order_p]
@@ -227,21 +231,36 @@ def solve_greedy(
         [jnp.zeros((1,), bool), sorted_p[1:] > sorted_p[:-1]]
     )
     dense_rank = jnp.cumsum(is_new.astype(jnp.int32))
+    n_classes = dense_rank[-1] + 1
+    # spread distinct levels evenly over the class budget (preserves order)
+    dense_rank = (dense_rank * MAX_PRIORITY_CLASSES) // jnp.maximum(n_classes, 1)
+    dense_rank = jnp.minimum(dense_rank, MAX_PRIORITY_CLASSES - 1)
     rank = jnp.zeros((J,), jnp.int32).at[order_p].set(dense_rank)
-    rank = jnp.minimum(rank, MAX_PRIORITY_CLASSES - 1)
     max_rank = jnp.max(jnp.where(jobs.valid, rank, 0))
 
+    # Tie-spreading field, sampled ONCE per solve: per-round threefry over
+    # [J, N] would dominate the round cost on TPU (RNG is ALU-bound while
+    # everything else here is HBM-bound). Rounds decorrelate by rotating
+    # the field along the node axis instead (one cheap gather).
+    base_noise = max(weights.noise, _MIN_TIE_NOISE) * jax.random.gumbel(
+        jax.random.PRNGKey(0), (J, N), jnp.float32
+    )
+
     def cond(state):
-        assigned, gpu_free, mem_free, rounds, progress = state
+        assigned, gpu_free, mem_free, rounds, active_rank, progress = state
         pending = jnp.any((assigned < 0) & jobs.valid)
-        # keep looping while classes are still being released even if the
-        # already-released classes made no progress this round
-        alive = progress | (rounds <= max_rank)
-        return alive & pending & (rounds < max_rounds)
+        return progress & pending & (rounds < max_rounds)
 
     def body(state):
-        assigned, gpu_free, mem_free, rounds, _ = state
-        unassigned = (assigned < 0) & jobs.valid & (rank <= rounds)
+        assigned, gpu_free, mem_free, rounds, active_rank, _ = state
+        # Settlement gating: only classes <= active_rank may bid; the gate
+        # advances when every released job is placed or bid-less. Gating by
+        # round index alone is not enough — a high class can still be
+        # resolving conflicts when the round counter releases the next
+        # class, and the lower class then steals capacity the loser needs
+        # (priority inversion).
+        allowed = rank <= active_rank
+        unassigned = (assigned < 0) & jobs.valid & allowed
         feas = (
             (jobs.gpu_demand[:, None] <= gpu_free[None, :] + _EPS)
             & (jobs.mem_demand[:, None] <= mem_free[None, :] + _EPS)
@@ -249,15 +268,12 @@ def solve_greedy(
             & unassigned[:, None]
         )
         fit_cost = _fit_cost(gpu_free, mem_free, p, weights, inv_gpu_cap, inv_mem_cap)
-        # Fresh tie-spreading field each round (deterministic in the round
-        # index) so repeated conflicts between the same bidders decorrelate.
-        tie_noise = max(weights.noise, _MIN_TIE_NOISE) * jax.random.gumbel(
-            jax.random.fold_in(jax.random.PRNGKey(0), rounds), (J, N), jnp.float32
-        )
+        tie_noise = jnp.roll(base_noise, rounds, axis=1)
         cost = jnp.where(feas, static_cost + fit_cost + tie_noise, INFEASIBLE)
 
-        best_cost = jnp.min(cost, axis=1)
         choice = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        # gather the winning cost instead of a second full [J, N] reduction
+        best_cost = jnp.take_along_axis(cost, choice[:, None], axis=1)[:, 0]
         has_bid = best_cost < INFEASIBLE * 0.5
         choice = jnp.where(has_bid, choice, N)
 
@@ -272,12 +288,19 @@ def solve_greedy(
         used_mem = jax.ops.segment_sum(
             jnp.where(accept, jobs.mem_demand, 0.0), choice, num_segments=N + 1
         )[:N]
+        # Gate advance: all released jobs placed or without a feasible bid.
+        # (A loser that can re-bid keeps the gate closed; capacity is finite
+        # so every class settles in finitely many rounds.)
+        still_unassigned = (assigned < 0) & jobs.valid & allowed
+        settled = ~jnp.any(still_unassigned & has_bid)
+        advanced = settled & (active_rank <= max_rank)
         return (
             assigned,
             gpu_free - used_gpu,
             mem_free - used_mem,
             rounds + 1,
-            jnp.any(accept),
+            jnp.where(advanced, active_rank + 1, active_rank),
+            jnp.any(accept) | advanced,
         )
 
     init = (
@@ -285,9 +308,10 @@ def solve_greedy(
         nodes.gpu_free,
         nodes.mem_free,
         jnp.int32(0),
+        jnp.int32(0),
         jnp.bool_(True),
     )
-    assigned, gpu_free, mem_free, rounds, _ = lax.while_loop(cond, body, init)
+    assigned, gpu_free, mem_free, rounds, _, _ = lax.while_loop(cond, body, init)
 
     assigned, gpu_free, mem_free = _gang_repair(p, assigned)
     placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)

@@ -18,4 +18,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon sitecustomize imports jax at interpreter startup, so jax's config
+# already captured JAX_PLATFORMS=axon from the kernel env before this file
+# ran — the env assignment above alone is inert. Update the live config too
+# (backends are still uninitialized at collection time, so this takes
+# effect; if it ever runs too late, the assertion below catches it).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    f"tests must run on the virtual CPU mesh, got {jax.devices()}"
+)
+assert len(jax.devices()) == 8, jax.devices()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

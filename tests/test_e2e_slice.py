@@ -169,3 +169,28 @@ class TestEndToEndSlice:
         assert wait_until(
             lambda: all(len(a._agents) == 0 for a in agents), timeout=10
         )
+
+    def test_placements_and_roles_stable_under_load(self, cluster):
+        """Regression: the heartbeat->solve feedback loop must not
+        oscillate placements (double-counted capacity), and lease renewal
+        must survive host load without role flips."""
+        store, calls, agents = cluster
+        store.create(LLMService.KIND, sample_cr().to_dict())
+        assert wait_until(
+            lambda: LLMService.from_dict(
+                store.get(LLMService.KIND, "deepseek-cache")
+            ).status.phase
+            == "Running"
+        )
+        svc = LLMService.from_dict(store.get(LLMService.KIND, "deepseek-cache"))
+        placements0 = svc.status.placements
+        coordinator0 = svc.status.cache_coordinator
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            svc = LLMService.from_dict(
+                store.get(LLMService.KIND, "deepseek-cache")
+            )
+            assert svc.status.placements == placements0, "placements moved"
+            assert svc.status.cache_coordinator == coordinator0, "role flip"
+            assert svc.status.phase == "Running"
+            time.sleep(0.25)

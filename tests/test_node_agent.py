@@ -201,8 +201,10 @@ class TestNodeAgent:
         try:
             state = NodeState.from_dict(store.get(NodeState.KIND, "node-a"))
             assert state.gpu_capacity == 8
-            assert state.gpu_free == 6  # two bound replicas x 1 gpu
-            assert state.gpu_memory_free_bytes == 32 << 30
+            # free == allocatable-to-framework, NOT net of our own bound
+            # replicas (the solver re-solves from full capacity each tick)
+            assert state.gpu_free == 8
+            assert state.gpu_memory_free_bytes == 64 << 30
             assert "org/already-cached" in state.cached_models
             assert state.heartbeat > 0
         finally:
