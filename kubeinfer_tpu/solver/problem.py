@@ -171,15 +171,24 @@ def encode_problem_arrays(
     node_mem_capacity_gib: np.ndarray | None = None,
     node_topology: np.ndarray | None = None,
     node_cached: np.ndarray | None = None,  # bool [N, MAX_MODELS]
+    job_multiple: int = 1,
+    node_multiple: int = 1,
 ) -> Problem:
     """Vectorized fast path: pack pre-built numpy arrays (one np.pad + one
     device_put per field). This is what the reconciler and benchmarks use —
     O(J+N) numpy ops, no per-object Python loop. ``encode_problem`` below is
-    the convenience row-based wrapper for small problems and tests."""
+    the convenience row-based wrapper for small problems and tests.
+
+    ``job_multiple``/``node_multiple`` round the padded axis up to a multiple
+    of a mesh axis size, so shards stay equal-sized when the problem is
+    placed on a device mesh whose axis does not divide the bucket (buckets
+    are all multiples of 64, so powers of two <= 64 never need this)."""
     J_true = int(job_gpu.shape[0])
     N_true = int(node_gpu_free.shape[0])
     J = bucket_size(max(J_true, 1))
     N = bucket_size(max(N_true, 1))
+    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
+    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
 
     def padj(a, fill, dtype):
         out = np.full(J, fill, dtype)
