@@ -83,6 +83,7 @@ class ReplicaAgent:
         self._supervisor: threading.Thread | None = None
         self._stopped = threading.Event()
         self.model_repo = ""
+        self.image = ""
         self.cache_shared = False
 
     # -- workload record I/O ------------------------------------------------
@@ -174,7 +175,16 @@ class ReplicaAgent:
                 coord.run_prepare()
             except Exception:
                 log.exception("%s: coordinator prepare failed", self.identity)
-                self._patch_replica(phase="Failed")
+                # Same stale-phase hazard as the Ready patch below: a torn-
+                # down role's late failure must not clobber the successor.
+                if not stop.is_set():
+                    self._patch_replica(phase="Failed")
+                return
+            if stop.is_set():
+                # Role torn down mid-download (_stop_role's join timed out):
+                # patching Ready now would overwrite the successor role's
+                # Starting with a stale phase and a dead endpoint.
+                coord.shutdown()
                 return
             self._patch_replica(phase="Ready", pod_ip=coord.endpoint)
             stop.wait()
@@ -236,7 +246,12 @@ class ReplicaAgent:
                 coord.run_prepare()
             except Exception:
                 log.exception("%s: model download failed", self.identity)
-                self._patch_replica(phase="Failed")
+                if not stop.is_set():
+                    self._patch_replica(phase="Failed")
+                return
+            if stop.is_set():
+                # same stale-Ready guard as the coordinator body
+                coord.shutdown()
                 return
             self._patch_replica(phase="Ready")
             stop.wait()
@@ -254,6 +269,7 @@ class ReplicaAgent:
     def start(self) -> None:
         w = self._read_workload()
         self.model_repo = w.model_repo
+        self.image = w.image
         self.cache_shared = w.cache_shared
         self._cache_group = w.cache_group
         if self.cache_shared:
@@ -371,10 +387,14 @@ class NodeAgent:
                 if r.node == self.node_name:
                     want[(w.metadata.namespace, w.metadata.name, r.index)] = w
 
-        # stop agents for replicas unbound/rebound elsewhere or model change
+        # Stop agents for replicas unbound/rebound elsewhere or spec drift.
+        # Image is part of the restart condition: the reconciler resets bound
+        # replicas to Starting on image change, and only a role restart
+        # re-asserts Ready — without this, image-only updates leave the
+        # replica Starting forever.
         for key, agent in list(self._agents.items()):
             w = want.get(key)
-            if w is None or agent.model_repo != w.model_repo:
+            if w is None or agent.model_repo != w.model_repo or agent.image != w.image:
                 agent.stop()
                 del self._agents[key]
 

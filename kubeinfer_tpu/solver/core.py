@@ -231,7 +231,12 @@ def solve_greedy(
         [jnp.zeros((1,), bool), sorted_p[1:] > sorted_p[:-1]]
     )
     dense_rank = jnp.cumsum(is_new.astype(jnp.int32))
-    n_classes = dense_rank[-1] + 1
+    # Count classes over VALID jobs only: padded rows sort last (neg_p=+inf)
+    # and would otherwise form a phantom class that shifts the scaled ranks
+    # and can merge the top two real priority levels into one settlement
+    # class (re-enabling the inversion the gate exists to prevent).
+    last_valid = jnp.maximum(jnp.sum(jobs.valid.astype(jnp.int32)) - 1, 0)
+    n_classes = dense_rank[last_valid] + 1
     # spread distinct levels evenly over the class budget (preserves order)
     dense_rank = (dense_rank * MAX_PRIORITY_CLASSES) // jnp.maximum(n_classes, 1)
     dense_rank = jnp.minimum(dense_rank, MAX_PRIORITY_CLASSES - 1)

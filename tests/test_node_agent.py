@@ -254,6 +254,34 @@ class TestNodeAgent:
         finally:
             na.stop()
 
+    def test_image_change_restarts_agent(self, tmp_path):
+        """Regression (advisor r1): the reconciler resets bound replicas to
+        Starting on image-only drift; only a role restart re-asserts Ready,
+        so the image must be part of the node agent's restart condition."""
+        store = Store()
+        mk_workload(store, "svc", replicas=1, nodes=("node-a",))
+        na = NodeAgent(
+            store, "node-a", gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path), downloader=fab_downloader(),
+            lease_timings=FAST_LEASE,
+        )
+        try:
+            na.tick()
+            first = na._agents[("default", "svc", 0)]
+            assert wait_until(lambda: phases(store)[0] == "Ready")
+            w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+            w.image = "img:v2"
+            w.replicas[0].phase = "Starting"  # what the reconciler does
+            store.update(Workload.KIND, w.to_dict())
+            na.tick()
+            second = na._agents[("default", "svc", 0)]
+            assert second is not first
+            assert second.image == "img:v2"
+            # the restarted role converges the replica back to Ready
+            assert wait_until(lambda: phases(store)[0] == "Ready")
+        finally:
+            na.stop()
+
 
 class TestReviewRegressions:
     def test_follower_waits_out_slow_coordinator_download(self, tmp_path):
@@ -284,6 +312,39 @@ class TestReviewRegressions:
         finally:
             for a in agents:
                 a.stop()
+
+    def test_torn_down_role_does_not_patch_stale_ready(self, tmp_path):
+        """Regression (advisor r1): a coordinator/solo role abandoned
+        mid-download must not overwrite the successor's Starting phase with
+        a stale Ready once its download finally completes."""
+        import threading
+
+        store = Store()
+        mk_workload(store, "svc", replicas=1, nodes=("node-a",), shared=False)
+        release = threading.Event()
+        fab = fab_downloader()
+
+        def gated_download(repo, path):
+            release.wait(timeout=30)
+            fab(repo, path)
+
+        agent = ReplicaAgent(
+            store, "svc", "default", 0, "node-a",
+            model_root=str(tmp_path), downloader=gated_download,
+            lease_timings=FAST_LEASE,
+        )
+        agent.start()  # solo role: download blocks on `release`
+        role_thread = agent._role_thread
+        assert role_thread is not None
+        # Tear the role down without waiting for the join (the production
+        # path is _stop_role's 10s join timing out mid-download).
+        agent._role_stop.set()
+        release.set()
+        role_thread.join(timeout=30)
+        assert not role_thread.is_alive()
+        # the abandoned body must NOT have patched Ready after teardown
+        assert phases(store) == ["Starting"]
+        agent.stop()
 
     def test_stopped_agent_does_not_resurrect_in_store(self, tmp_path):
         """Stopping the coordinator agent must not leave a spurious Ready

@@ -325,3 +325,37 @@ class TestPriorityGating:
         nodes = np.asarray(a.node)
         assert (nodes[:4] >= 0).all(), nodes
         assert (nodes[4:] == -1).all(), nodes
+
+    def test_padded_jobs_do_not_inflate_priority_classes(self):
+        """Regression (advisor r1): padded rows sort last with +inf key and
+        used to form a phantom priority class. With exactly
+        MAX_PRIORITY_CLASSES distinct real priorities the scaled ranks then
+        became {0,0,1,2}, merging the top two classes — the lower of which
+        could steal capacity a top-class loser only discovers a round later.
+        """
+        import numpy as np
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        # 2 nodes x 8 chips. A,B (prio 300, 6 chips) both prefer node 0
+        # (cache hit for model 1); the conflict loser discovers node 1 only
+        # in the next round. C (prio 200, 4 chips) prefers node 1 (cache hit
+        # for model 2): if classes 300/200 merge, C takes node 1 in round 1
+        # and the loser of A/B can never place. D (100) and E (0) complete
+        # the 4 distinct priority levels and fit the leftovers.
+        node_cached = np.zeros((2, 4), bool)
+        node_cached[0, 1] = True
+        node_cached[1, 2] = True
+        p = encode_problem_arrays(
+            job_gpu=np.array([6, 6, 4, 1, 1], np.float32),
+            job_mem_gib=np.zeros(5, np.float32),
+            job_priority=np.array([300, 300, 200, 100, 0], np.float32),
+            job_model=np.array([1, 1, 2, 3, 3], np.int32),
+            node_gpu_free=np.full(2, 8.0, np.float32),
+            node_mem_free_gib=np.full(2, 64.0, np.float32),
+            node_cached=node_cached,
+        )
+        a = solve_greedy(p)
+        nodes = np.asarray(a.node)
+        assert (nodes[:2] >= 0).all(), nodes  # both top-class jobs placed
+        assert nodes[2] == -1, nodes  # class-200 job must not fit
+        assert (nodes[3:5] >= 0).all(), nodes  # 1-chip jobs fill leftovers
