@@ -175,6 +175,10 @@ class ReplicaAgent:
                 coord.run_prepare()
             except Exception:
                 log.exception("%s: coordinator prepare failed", self.identity)
+                # Release whatever run_prepare started (the model server may
+                # already be serving when the runtime fails) or a successor
+                # coordinator hits EADDRINUSE on a fixed serve port.
+                coord.shutdown()
                 # Same stale-phase hazard as the Ready patch below: a torn-
                 # down role's late failure must not clobber the successor.
                 if not stop.is_set():
@@ -246,6 +250,7 @@ class ReplicaAgent:
                 coord.run_prepare()
             except Exception:
                 log.exception("%s: model download failed", self.identity)
+                coord.shutdown()
                 if not stop.is_set():
                     self._patch_replica(phase="Failed")
                 return

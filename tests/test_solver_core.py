@@ -328,11 +328,11 @@ class TestPriorityGating:
 
     def test_padded_jobs_do_not_inflate_priority_classes(self):
         """Regression (advisor r1): padded rows sort last with +inf key and
-        used to form a phantom priority class. With exactly
-        MAX_PRIORITY_CLASSES distinct real priorities the scaled ranks then
-        became {0,0,1,2}, merging the top two classes — the lower of which
-        could steal capacity a top-class loser only discovers a round later.
-        """
+        used to form a phantom priority class. With exactly fence_classes
+        (4, see solve_greedy's class compression) distinct real priorities
+        the scaled ranks then became {0,0,1,2}, merging the top two classes
+        — the lower of which could steal capacity a top-class loser only
+        discovers a round later."""
         import numpy as np
         from kubeinfer_tpu.solver.problem import encode_problem_arrays
 
