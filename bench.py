@@ -152,6 +152,14 @@ def main() -> None:
         "native_p50_ms": round(native_stats["p50_ms"], 3),
         "device_solve_ms": round(dev_ms, 3),
         "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+        # e2e with the measured transport floor backed out: what the same
+        # backend pays on local (non-relayed) TPU hardware, where dispatch
+        # is ~0.1ms. The 50ms north-star budget is defined against local
+        # attachment; the relay floor alone exceeds it.
+        "e2e_minus_dispatch_ms": round(
+            max(jax_stats["p50_ms"] - dispatch_floor_ms, 0.0), 3
+        ),
+        "device_vs_native": round(native_stats["p50_ms"] / max(dev_ms, 1e-9), 2),
         "placed": jax_stats["placed"],
         "jobs": 10_000,
         "nodes": 1_000,

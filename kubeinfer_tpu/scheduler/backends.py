@@ -163,9 +163,11 @@ class JaxBackend(SchedulerBackend):
         )
         t_encode = time.perf_counter()
         out = jax_solve(problem, policy=self._policy.value)
-        assignment = np.asarray(
-            jax.device_get(out.node)[: req.num_jobs], np.int32
-        )
+        # ONE host readback for everything the caller needs: each extra
+        # sync (a separate np.asarray/int() call) is a full host<->device
+        # round trip, which under a remote PJRT relay costs ~65-100ms.
+        node_host, rounds_host = jax.device_get((out.node, out.rounds))
+        assignment = np.asarray(node_host[: req.num_jobs], np.int32)
         # Padded job rows can't place (valid=False) and padded node columns
         # can't be chosen (valid=False), so clipping to the true axes is
         # lossless; count placed on the clipped view.
@@ -176,7 +178,7 @@ class JaxBackend(SchedulerBackend):
             placed,
             (t1 - t0) * 1e3,
             self.name,
-            rounds=int(out.rounds),
+            rounds=int(rounds_host),
             extras={"encode_ms": (t_encode - t0) * 1e3},
         )
 

@@ -1,0 +1,235 @@
+"""Pallas TPU kernels for the greedy solver's round loop.
+
+Why these exist: the round loop is a handful of [N, J] reductions whose
+producers are broadcasts of [J]/[N] vectors. Under plain XLA each reduction
+materializes its producer to HBM (measured ~1.3ms/round at 12288x1024 on a
+v5e — ~8 full HBM round-trips), because multi-consumer broadcast producers
+defeat reduction fusion. Here each round becomes:
+
+- ONE ``bid`` kernel: tiles the resident [N, J] cost field S through VMEM
+  (TILE_N=128 sublanes x J lanes), fusing feasibility, the per-node
+  priority fence, static-bound cost quantization, and the packed
+  (cost | node) i32 min — S is read from HBM exactly once per round and
+  nothing [N, J]-sized is ever written back.
+- TWO ``accept`` kernel calls (first chance + second chance): per-node
+  column reductions (bidder demand totals + fused-key winner) whose inputs
+  are four [J] vectors; the [TILE_N, J] broadcast lives only in VMEM.
+
+The jnp reference implementations live in ``core.py`` (`_round_bids_jnp`,
+`_accept_reduce_jnp`) and remain the code path for CPU tests, sharded
+(GSPMD) solves, and bucket shapes not divisible by 128. ``interpret=True``
+runs these kernels on CPU for parity tests.
+
+Design refs: /opt/skills/guides/pallas_guide.md (grid/BlockSpec, iota,
+reduction patterns). No reference-repo counterpart exists: the reference
+scheduler has no placement solver at all (SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_N = 128
+# Plain Python scalars: module-level jnp constants would be captured by the
+# kernel closures, which pallas_call rejects ("captures constants"). Packed
+# values are non-negative int32 (i31): Mosaic has no unsigned reductions.
+_I32MAX = 0x7FFFFFFF
+_EPS = 1e-4
+# Large-but-finite sentinel for "this job may not bid" (placed/invalid);
+# finite so `rank <= minrank` comparisons stay well-defined.
+RANK_INF = 1e9
+
+
+def _bid_kernel(
+    d_ref,  # [1, J] f32 gpu demand
+    md_ref,  # [1, J] f32 mem demand
+    rankf_ref,  # [1, J] f32 fence rank, RANK_INF when may-not-bid
+    gf_ref,  # [TILE_N, 1] f32 gpu free (invalid nodes pre-folded to -1)
+    mf_ref,  # [TILE_N, 1] f32 mem free
+    u_ref,  # [TILE_N, 1] f32 live best-fit pressure
+    s_ref,  # [TILE_N, J] f32 resident cost field tile
+    out_ref,  # [8, J] i32 per-16-node-group packed (cost | node) mins
+    *,
+    q_lo: float,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+):
+    t = pl.program_id(0)
+    big = jnp.int32(_I32MAX)
+    rank_inf = jnp.float32(RANK_INF)
+    d = d_ref[:]
+    md = md_ref[:]
+    rankf = rankf_ref[:]
+    gf = gf_ref[:]
+    mf = mf_ref[:]
+
+    feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, J]
+    # Per-node priority fence: bid only if no higher-priority unplaced job
+    # finds this node feasible. RANK_INF rows drop out of the min and the
+    # <= check both.
+    minrank = jnp.min(
+        jnp.where(feas, rankf, rank_inf), axis=1, keepdims=True
+    )  # [TILE_N, 1]
+    allowed = feas & (rankf <= minrank) & (rankf < rank_inf * 0.5)
+
+    q = jnp.clip((s_ref[:] + u_ref[:] - q_lo) * q_scale, 0.0, q_max)
+    n_glob = t * TILE_N + jax.lax.broadcasted_iota(
+        jnp.int32, feas.shape, 0
+    )
+    packed = jnp.where(
+        allowed,
+        (q.astype(jnp.int32) << node_idx_bits) | n_glob,
+        big,
+    )
+    # Eight 16-node group mins per tile: the TPU output block needs >= 8
+    # sublanes anyway, and finer groups give the second-chance pass better
+    # alternates. Even a single-tile problem (N=128) has 7 other groups.
+    out_ref[:] = jnp.min(
+        packed.reshape(8, TILE_N // 8, packed.shape[1]), axis=1
+    )
+
+
+def bid_reduce_pallas(
+    s_t: jax.Array,  # [N, J] resident cost field
+    u: jax.Array,  # [N]
+    gf_eff: jax.Array,  # [N] (invalid nodes folded to -1)
+    mf: jax.Array,  # [N]
+    d: jax.Array,  # [J]
+    md: jax.Array,  # [J]
+    rankf_eff: jax.Array,  # [J] (RANK_INF when may-not-bid)
+    *,
+    q_lo: float,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One S read -> (primary, alternate) packed i32 bids per job.
+
+    The alternate is the best node outside the primary's 16-node group —
+    a cross-group second choice for the solver's second-chance pass.
+    Group mins match core._round_bids_jnp exactly (parity-tested).
+    """
+    N, J = s_t.shape
+    if N % TILE_N or J % 128:
+        raise ValueError(
+            f"pallas round kernels need 128-aligned axes, got N={N} J={J}; "
+            "use accel='jnp' for unaligned bucket shapes"
+        )
+    tiles = N // TILE_N
+    kern = functools.partial(
+        _bid_kernel,
+        q_lo=q_lo,
+        q_scale=q_scale,
+        q_max=q_max,
+        node_idx_bits=node_idx_bits,
+    )
+    row = pl.BlockSpec((1, J), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    col = pl.BlockSpec((TILE_N, 1), lambda t: (t, 0), memory_space=pltpu.VMEM)
+    per_group = pl.pallas_call(
+        kern,
+        grid=(tiles,),
+        in_specs=[
+            row,  # d
+            row,  # md
+            row,  # rankf
+            col,  # gf
+            col,  # mf
+            col,  # u
+            pl.BlockSpec(
+                (TILE_N, J), lambda t: (t, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec((8, J), lambda t: (t, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8 * tiles, J), jnp.int32),
+        interpret=interpret,
+    )(
+        d.reshape(1, J),
+        md.reshape(1, J),
+        rankf_eff.reshape(1, J),
+        gf_eff.reshape(N, 1),
+        mf.reshape(N, 1),
+        u.reshape(N, 1),
+        s_t,
+    )
+    prim = jnp.min(per_group, axis=0)  # [J]
+    prim_group = jnp.argmin(per_group, axis=0)
+    g_iota = jnp.arange(8 * tiles, dtype=jnp.int32)
+    alt = jnp.min(
+        jnp.where(
+            g_iota[:, None] == prim_group[None, :],
+            jnp.int32(_I32MAX),
+            per_group,
+        ),
+        axis=0,
+    )
+    return prim, alt
+
+
+def _accept_kernel(
+    ch_ref,  # [1, J] i32 chosen node (N = no bid)
+    key_ref,  # [1, J] i32 accept key
+    d_ref,  # [1, J] f32
+    md_ref,  # [1, J] f32
+    tg_ref,  # [TILE_N, 1] f32 out: bidder gpu total
+    tm_ref,  # [TILE_N, 1] f32 out: bidder mem total
+    win_ref,  # [TILE_N, 1] i32 out: winning key
+):
+    t = pl.program_id(0)
+    big = jnp.int32(_I32MAX)
+    ch = ch_ref[:]
+    key = key_ref[:]
+    n_glob = t * TILE_N + jax.lax.broadcasted_iota(
+        jnp.int32, (TILE_N, ch.shape[1]), 0
+    )
+    mine = ch == n_glob  # [TILE_N, J]; the N sentinel matches no node
+    tg_ref[:] = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
+    tm_ref[:] = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
+    win_ref[:] = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
+
+
+def accept_reduce_pallas(
+    choice: jax.Array,  # i32[J]
+    accept_key: jax.Array,  # i32[J]
+    d: jax.Array,  # f32[J]
+    md: jax.Array,  # f32[J]
+    num_nodes: int,
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-node (gpu total, mem total, winner key) over bidders."""
+    J = choice.shape[0]
+    if num_nodes % TILE_N or J % 128:
+        raise ValueError(
+            f"pallas round kernels need 128-aligned axes, got N={num_nodes} "
+            f"J={J}; use accel='jnp' for unaligned bucket shapes"
+        )
+    tiles = num_nodes // TILE_N
+    row = pl.BlockSpec((1, J), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    col_out = pl.BlockSpec(
+        (TILE_N, 1), lambda t: (t, 0), memory_space=pltpu.VMEM
+    )
+    tg, tm, win = pl.pallas_call(
+        _accept_kernel,
+        grid=(tiles,),
+        in_specs=[row, row, row, row],
+        out_specs=[col_out, col_out, col_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
+            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
+            jax.ShapeDtypeStruct((num_nodes, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        choice.reshape(1, J),
+        accept_key.reshape(1, J),
+        d.reshape(1, J),
+        md.reshape(1, J),
+    )
+    return tg[:, 0], tm[:, 0], win[:, 0]

@@ -119,4 +119,6 @@ def solve_sharded(
     sharded = shard_problem(p, mesh)
     # No mesh context needed: the jitted solver traces on logical shapes and
     # GSPMD propagates the NamedSharding placements through the round loop.
-    return core.solve(sharded, policy=policy, weights=weights)
+    # accel='jnp': pallas_call does not auto-partition under GSPMD; the jnp
+    # round ops are the multi-chip code path.
+    return core.solve(sharded, policy=policy, weights=weights, accel="jnp")

@@ -359,3 +359,29 @@ class TestPriorityGating:
         assert (nodes[:2] >= 0).all(), nodes  # both top-class jobs placed
         assert nodes[2] == -1, nodes  # class-200 job must not fit
         assert (nodes[3:5] >= 0).all(), nodes  # 1-chip jobs fill leftovers
+
+
+class TestPallasParity:
+    def test_interpret_matches_jnp(self):
+        """The Pallas round kernels (interpret mode on CPU) must place the
+        same assignment as the jnp reference ops — they implement identical
+        math, tile-by-tile."""
+        import numpy as np
+        from kubeinfer_tpu.solver.core import solve_greedy
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        rng = np.random.default_rng(3)
+        J, N = 128, 128  # minimal 128-aligned shapes for the tiled kernels
+        p = encode_problem_arrays(
+            job_gpu=rng.integers(1, 8, J).astype(np.float32),
+            job_mem_gib=rng.integers(4, 64, J).astype(np.float32),
+            job_priority=rng.integers(0, 4, J).astype(np.float32),
+            job_model=rng.integers(0, 16, J).astype(np.int32),
+            node_gpu_free=np.full(N, 16.0, np.float32),
+            node_mem_free_gib=np.full(N, 128.0, np.float32),
+            node_cached=(rng.random((N, 16)) < 0.1),
+        )
+        ref = solve_greedy(p, accel="jnp")
+        pal = solve_greedy(p, accel="interpret")
+        assert np.array_equal(np.asarray(ref.node), np.asarray(pal.node))
+        assert int(ref.placed) == int(pal.placed)
