@@ -13,6 +13,7 @@ reference declared but never recorded (SURVEY.md §2 #10).
 
 from __future__ import annotations
 
+import json
 import logging
 import pathlib
 import subprocess
@@ -32,6 +33,21 @@ def hub_download(model_repo: str, model_path: str) -> None:
     subprocess.run(
         ["huggingface-cli", "download", model_repo, "--local-dir", model_path],
         check=True,
+    )
+
+
+def mock_download(model_repo: str, model_path: str) -> None:
+    """Fabricate a tiny model directory — the no-egress downloader used by
+    demos, process-level e2e, and the quickstart (the role the reference's
+    vllm-mock image plays for its Kind e2e, test/testdata/vllm-mock)."""
+    root = pathlib.Path(model_path)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "config.json").write_text(
+        json.dumps({"model_type": "mock", "repo": model_repo}) + "\n"
+    )
+    (root / "weights").mkdir(exist_ok=True)
+    (root / "weights" / "model-00001.safetensors").write_bytes(
+        b"\0" * 4096
     )
 
 

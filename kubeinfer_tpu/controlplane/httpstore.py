@@ -1,0 +1,418 @@
+"""HTTP transport for the control-plane store — the API-server wire protocol.
+
+The reference control plane is the Kubernetes API server: the manager and
+every agent reach it over HTTPS with ServiceAccount bearer tokens
+(cmd/agent/main.go:56-63 in-cluster config; cmd/manager/main.go:157
+GetConfigOrDie). kubeinfer_tpu is standalone, so the manager process *hosts*
+the store (``StoreServer``) and agents/CLIs reach it through ``RemoteStore``,
+which implements the exact same interface as the in-process ``Store``
+(create/get/update/delete/list/watch) so every component runs unchanged
+in-process (tests, e2e slice) or cross-process (real deployment).
+
+Protocol (JSON over HTTP/1.1):
+
+- ``GET  /healthz``                         liveness, unauthenticated
+- ``GET  /apis/{kind}``                     list (``?namespace=`` optional)
+- ``POST /apis/{kind}``                     create  → 409 already_exists
+- ``GET  /apis/{kind}/{ns}/{name}``         get     → 404
+- ``PUT  /apis/{kind}/{ns}/{name}``         CAS update → 409 conflict
+- ``DELETE /apis/{kind}/{ns}/{name}``       delete  → 404
+- ``GET  /rv``                              current resourceVersion
+- ``GET  /watch?since=RV&timeout=S[&kind=&namespace=]``
+  long-poll: events with resourceVersion > since, or ``[]`` on timeout.
+
+Auth parity: the reference secures its endpoints with token authn/authz
+filters (cmd/manager/main.go:126-138). Here a static bearer token guards
+every route except /healthz; no token configured = open (dev mode),
+mirroring ``--metrics-secure=false``.
+
+Admission parity: LLMService writes are schema-validated server-side
+(the CRD schema the reference API server enforces,
+config/crd/bases/ai.ruijie.io_llmservices.yaml:45-60).
+"""
+
+from __future__ import annotations
+
+import collections
+import hmac
+import json
+import logging
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from kubeinfer_tpu.api.types import LLMService, ValidationError
+from kubeinfer_tpu.controlplane.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+    WatchEvent,
+)
+
+log = logging.getLogger(__name__)
+
+EVENT_LOG_SIZE = 65536  # ring of recent events served to long-pollers
+
+
+class StoreServer:
+    """Serves a Store over HTTP and republishes its watch stream."""
+
+    def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 0,
+                 token: str = "") -> None:
+        self._store = store
+        self._token = token
+        # Event ring: long-pollers replay from here by resourceVersion.
+        self._events: collections.deque[WatchEvent] = collections.deque(
+            maxlen=EVENT_LOG_SIZE
+        )
+        self._events_cond = threading.Condition()
+        self._watch = store.watch()
+        self._pump = threading.Thread(
+            target=self._pump_events, daemon=True, name="store-event-pump"
+        )
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("httpstore: " + fmt, *args)
+
+            def _send(self, code: int, body: dict | list) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _authed(self) -> bool:
+                if not server._token:
+                    return True
+                got = self.headers.get("Authorization", "")
+                return hmac.compare_digest(got, f"Bearer {server._token}")
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _drop_body(self) -> None:
+                # Responding without consuming the request body desyncs
+                # HTTP/1.1 keep-alive: the unread bytes would be parsed as
+                # the next request line by pooled clients.
+                n = int(self.headers.get("Content-Length", 0))
+                if n:
+                    self.rfile.read(n)
+
+            def _route(self, method: str) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                q = urllib.parse.parse_qs(parsed.query)
+                if parts == ["healthz"]:
+                    self._drop_body()
+                    self._send(200, {"status": "ok"})
+                    return
+                if not self._authed():
+                    self._drop_body()
+                    self._send(401, {"error": "unauthorized"})
+                    return
+                try:
+                    if parts == ["rv"] and method == "GET":
+                        self._send(200, {"resourceVersion": server._store._rv})
+                    elif parts == ["watch"] and method == "GET":
+                        since = int(q.get("since", ["0"])[0])
+                        timeout = min(float(q.get("timeout", ["30"])[0]), 300.0)
+                        kind = q.get("kind", [None])[0]
+                        ns = q.get("namespace", [None])[0]
+                        evs, rv = server._poll_events(since, timeout, kind, ns)
+                        self._send(200, {
+                            "resourceVersion": rv,
+                            "events": [
+                                {
+                                    "type": e.type, "kind": e.kind,
+                                    "namespace": e.namespace, "name": e.name,
+                                    "object": e.object,
+                                    "resourceVersion": e.resource_version,
+                                }
+                                for e in evs
+                            ],
+                        })
+                    elif len(parts) == 2 and parts[0] == "apis":
+                        kind = parts[1]
+                        if method == "GET":
+                            ns = q.get("namespace", [None])[0]
+                            self._send(200, server._store.list(kind, ns))
+                        elif method == "POST":
+                            obj = server._admit(kind, self._body())
+                            self._send(201, server._store.create(kind, obj))
+                        else:
+                            self._drop_body()
+                            self._send(405, {"error": "method not allowed"})
+                    elif len(parts) == 4 and parts[0] == "apis":
+                        kind, ns, name = parts[1], parts[2], parts[3]
+                        if method == "GET":
+                            self._send(200, server._store.get(kind, name, ns))
+                        elif method == "PUT":
+                            obj = server._admit(kind, self._body())
+                            self._send(200, server._store.update(kind, obj))
+                        elif method == "DELETE":
+                            server._store.delete(kind, name, ns)
+                            self._send(200, {"status": "deleted"})
+                        else:
+                            self._drop_body()
+                            self._send(405, {"error": "method not allowed"})
+                    else:
+                        self._drop_body()
+                        self._send(404, {"error": "no such route"})
+                except NotFoundError as e:
+                    self._send(404, {"error": "not_found", "message": str(e)})
+                except AlreadyExistsError as e:
+                    self._send(409, {"error": "already_exists", "message": str(e)})
+                except ConflictError as e:
+                    self._send(409, {"error": "conflict", "message": str(e)})
+                except (ValidationError, ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": "invalid", "message": str(e)})
+                except Exception as e:  # don't kill the connection thread
+                    log.exception("httpstore: internal error")
+                    self._send(500, {"error": "internal", "message": str(e)})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="store-http"
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "StoreServer":
+        self._pump.start()
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._watch.close()
+        with self._events_cond:
+            self._events_cond.notify_all()
+
+    # -- admission --------------------------------------------------------
+
+    @staticmethod
+    def _admit(kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        """Server-side schema validation + defaulting for kinds with a
+        schema (what the CRD schema + API-server defaulting do for the
+        reference). The typed round-trip materializes defaulted fields
+        (image, status skeleton) so consumers never see partial objects."""
+        if kind == LLMService.KIND:
+            svc = LLMService.from_dict(obj)
+            svc.validate()
+            return svc.to_dict()
+        return obj
+
+    # -- event plumbing ---------------------------------------------------
+
+    def _pump_events(self) -> None:
+        while True:
+            ev = self._watch.next_event(timeout=1.0)
+            if ev is None:
+                if self._w_closed():
+                    return
+                continue
+            with self._events_cond:
+                self._events.append(ev)
+                self._events_cond.notify_all()
+
+    def _w_closed(self) -> bool:
+        return self._watch._w.closed.is_set()
+
+    def _poll_events(
+        self, since: int, timeout: float, kind: str | None, ns: str | None
+    ) -> tuple[list[WatchEvent], int]:
+        def matching() -> list[WatchEvent]:
+            return [
+                e for e in self._events
+                if e.resource_version > since
+                and (kind is None or e.kind == kind)
+                and (ns is None or e.namespace == ns)
+            ]
+
+        with self._events_cond:
+            evs = matching()
+            if not evs and timeout > 0:
+                self._events_cond.wait(timeout)
+                evs = matching()
+            rv = self._events[-1].resource_version if self._events else since
+            return evs, max(rv, since)
+
+
+class RemoteStore:
+    """Store-interface client over the wire protocol above.
+
+    Drop-in for ``Store``: agents, controllers, and the CLI take whichever
+    they are handed (the reference equivalently swaps in-cluster and
+    kubeconfig clients, cmd/agent/main.go:56 vs _archive/election).
+    """
+
+    def __init__(self, base_url: str, token: str = "",
+                 request_timeout_s: float = 35.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        self._timeout = request_timeout_s
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _req(self, method: str, path: str, body: dict | None = None,
+             timeout: float | None = None) -> Any:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self._timeout
+            ) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                pass
+            msg = payload.get("message", str(e))
+            code = payload.get("error", "")
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409 and code == "already_exists":
+                raise AlreadyExistsError(msg) from None
+            if e.code == 409:
+                raise ConflictError(msg) from None
+            if e.code == 400:
+                raise ValidationError(msg) from None
+            if e.code == 401:
+                raise PermissionError(f"unauthorized: {url}") from None
+            raise
+
+    def healthz(self) -> bool:
+        try:
+            return self._req("GET", "/healthz")["status"] == "ok"
+        except Exception:
+            return False
+
+    # -- Store interface --------------------------------------------------
+
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        return self._req("POST", f"/apis/{kind}", obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
+        return self._req("GET", f"/apis/{kind}/{namespace}/{name}")
+
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        return self._req("PUT", f"/apis/{kind}/{ns}/{name}", obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._req("DELETE", f"/apis/{kind}/{namespace}/{name}")
+
+    def list(self, kind: str, namespace: str | None = None) -> list[dict[str, Any]]:
+        path = f"/apis/{kind}"
+        if namespace is not None:
+            path += f"?namespace={urllib.parse.quote(namespace)}"
+        return self._req("GET", path)
+
+    def watch(self, kind: str | None = None,
+              namespace: str | None = None) -> "RemoteWatch":
+        rv = self._req("GET", "/rv")["resourceVersion"]
+        return RemoteWatch(self, kind, namespace, since=rv)
+
+
+class RemoteWatch:
+    """Long-poll watch stream with the in-process ``Watch`` interface."""
+
+    def __init__(self, store: RemoteStore, kind: str | None,
+                 namespace: str | None, since: int) -> None:
+        self._store = store
+        self._kind = kind
+        self._ns = namespace
+        self._since = since
+        self._pending: collections.deque[WatchEvent] = collections.deque()
+        self._closed = False
+
+    def _fetch(self, timeout: float) -> None:
+        q = {"since": str(self._since), "timeout": f"{timeout:.3f}"}
+        if self._kind is not None:
+            q["kind"] = self._kind
+        if self._ns is not None:
+            q["namespace"] = self._ns
+        path = "/watch?" + urllib.parse.urlencode(q)
+        # network timeout must outlive the server-side long-poll window
+        resp = self._store._req("GET", path, timeout=timeout + 10.0)
+        self._since = max(self._since, resp["resourceVersion"])
+        for e in resp["events"]:
+            self._pending.append(
+                WatchEvent(
+                    type=e["type"], kind=e["kind"], namespace=e["namespace"],
+                    name=e["name"], object=e["object"],
+                    resource_version=e["resourceVersion"],
+                )
+            )
+            self._since = max(self._since, e["resourceVersion"])
+
+    def next_event(self, timeout: float | None = None) -> WatchEvent | None:
+        if self._closed:
+            return None
+        if not self._pending:
+            try:
+                self._fetch(timeout if timeout is not None else 30.0)
+            except (OSError, NotFoundError):
+                return None  # transient; caller's periodic tick covers it
+        return self._pending.popleft() if self._pending else None
+
+    def drain(self) -> list[WatchEvent]:
+        if not self._closed and not self._pending:
+            try:
+                self._fetch(timeout=0.0)
+            except (OSError, NotFoundError):
+                pass
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def close(self) -> None:
+        self._closed = True
+
+    def __iter__(self):
+        while not self._closed:
+            ev = self.next_event(timeout=1.0)
+            if ev is not None:
+                yield ev

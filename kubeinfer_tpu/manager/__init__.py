@@ -1,0 +1,287 @@
+"""The manager process: control-plane store + reconcile loop + endpoints.
+
+Parity target: reference cmd/manager/main.go:56-204 — flag parsing, metrics
+server with auth filtering (:126-138), health/ready probes (:190-197),
+leader election option (:162-163), controller startup (:181-184), blocking
+run (:200).
+
+Deliberate differences:
+
+- The reference manager is a *client* of the Kubernetes API server; this
+  manager **hosts** the control plane itself (``StoreServer``) because the
+  framework is standalone. ``--store-connect`` instead joins an external
+  store (another manager's, or a test harness'), which is when
+  ``--leader-elect`` matters — exactly the reference's HA topology.
+- Metrics auth is a static bearer token (``--auth-token-file``); the
+  reference's authn/authz delegates to the cluster
+  (filters.WithAuthenticationAndAuthorization). Same posture: probes open,
+  everything else tokened.
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from kubeinfer_tpu import metrics
+from kubeinfer_tpu.controller.reconciler import Controller
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore, StoreServer
+from kubeinfer_tpu.controlplane.store import Store
+from kubeinfer_tpu.coordination.lease import LeaseManager
+from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+log = logging.getLogger(__name__)
+
+MANAGER_LEASE = "kubeinfer-manager"  # leader-election lease name
+
+
+class EndpointServer:
+    """Tiny HTTP endpoint mux for probes and /metrics.
+
+    Routes map path → callable returning (status, content_type, body).
+    Paths in ``open_paths`` skip auth (probes must be reachable by the
+    platform's health checker without credentials — main.go:190-197).
+    """
+
+    def __init__(self, host: str, port: int,
+                 routes: dict[str, Callable[[], tuple[int, str, str]]],
+                 token: str = "", open_paths: tuple[str, ...] = ()) -> None:
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("endpoint: " + fmt, *args)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                handler = routes.get(path)
+                if handler is None:
+                    self._respond(404, "text/plain", "not found\n")
+                    return
+                if token and path not in open_paths:
+                    got = self.headers.get("Authorization", "")
+                    if not hmac.compare_digest(got, f"Bearer {token}"):
+                        self._respond(401, "text/plain", "unauthorized\n")
+                        return
+                try:
+                    self._respond(*handler())
+                except Exception as e:
+                    log.exception("endpoint %s failed", path)
+                    self._respond(500, "text/plain", f"error: {e}\n")
+
+            def _respond(self, code: int, ctype: str, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"endpoints-{port}",
+        )
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "EndpointServer":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@dataclass
+class ManagerConfig:
+    """Flag surface (cmd/manager/main.go:65-86 analogue)."""
+
+    store_bind_host: str = "127.0.0.1"
+    store_bind_port: int = 18080
+    metrics_bind_host: str = "127.0.0.1"
+    metrics_bind_port: int = 18081  # ref --metrics-bind-address
+    health_bind_host: str = "127.0.0.1"
+    health_bind_port: int = 18082  # ref --health-probe-bind-address
+    store_connect: str = ""  # join external store instead of hosting
+    auth_token: str = ""
+    tick_interval_s: float = 1.0
+    node_ttl_s: float = 30.0
+    leader_elect: bool = False  # ref --leader-elect
+    namespace: str = "default"
+    identity: str = ""  # leader-election holder id (default: derived)
+    # (duration_s, renew_s, retry_s) override for tests/demos;
+    # None = reference timings (election.go:41-43)
+    lease_timings: tuple[float, float, float] | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class Manager:
+    """Composable manager: store (hosted or joined), controller, endpoints."""
+
+    def __init__(self, cfg: ManagerConfig, clock: Clock | None = None) -> None:
+        self.cfg = cfg
+        self._clock = clock or RealClock()
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._is_leader = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+        if cfg.store_connect:
+            self.store_server = None
+            self.store = RemoteStore(cfg.store_connect, token=cfg.auth_token)
+        else:
+            self._local_store = Store()
+            self.store_server = StoreServer(
+                self._local_store, cfg.store_bind_host, cfg.store_bind_port,
+                token=cfg.auth_token,
+            )
+            # The in-process controller bypasses HTTP (same truth, no hop).
+            self.store = self._local_store
+
+        self.controller = Controller(
+            self.store, clock=self._clock, node_ttl_s=cfg.node_ttl_s
+        )
+        self._lease: LeaseManager | None = None
+
+        self.health_server = EndpointServer(
+            cfg.health_bind_host, cfg.health_bind_port,
+            routes={
+                "/healthz": lambda: (200, "text/plain", "ok\n"),
+                "/readyz": self._readyz,
+            },
+        )
+        self.metrics_server = EndpointServer(
+            cfg.metrics_bind_host, cfg.metrics_bind_port,
+            routes={
+                "/metrics": lambda: (
+                    200, "text/plain; version=0.0.4",
+                    metrics.REGISTRY.render(),
+                ),
+                "/healthz": lambda: (200, "text/plain", "ok\n"),
+            },
+            token=cfg.auth_token,
+            open_paths=("/healthz",),
+        )
+
+    # -- probes -----------------------------------------------------------
+
+    def _readyz(self) -> tuple[int, str, str]:
+        if self._ready.is_set():
+            return 200, "text/plain", "ok\n"
+        return 503, "text/plain", "not ready\n"
+
+    @property
+    def store_address(self) -> str:
+        if self.store_server is not None:
+            return self.store_server.address
+        return self.cfg.store_connect
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Manager":
+        if self.store_server is not None:
+            self.store_server.start()
+            log.info("store listening on %s", self.store_server.address)
+        self.health_server.start()
+        self.metrics_server.start()
+        log.info(
+            "probes on :%d, metrics on :%d%s",
+            self.health_server.port, self.metrics_server.port,
+            " (token auth)" if self.cfg.auth_token else " (NO AUTH — dev mode)",
+        )
+        if not self.cfg.auth_token:
+            log.warning(
+                "metrics/store endpoints are UNAUTHENTICATED; pass "
+                "--auth-token-file for the reference's secured posture"
+            )
+
+        if self.cfg.leader_elect:
+            # HA parity (main.go:162-163): reconcile only while holding the
+            # manager lease; standby managers take over on expiry.
+            timing_kw = {}
+            if self.cfg.lease_timings is not None:
+                d, rn, rt = self.cfg.lease_timings
+                timing_kw = dict(
+                    duration_s=d, renew_interval_s=rn, retry_interval_s=rt
+                )
+            self._lease = LeaseManager(
+                self.store, self.cfg.namespace, MANAGER_LEASE,
+                identity=self.cfg.identity or f"manager-{id(self):x}",
+                clock=self._clock, **timing_kw,
+            )
+            self._lease.start(self._on_elected, self._on_lost)
+        else:
+            self._is_leader.set()
+            self._start_controller()
+        return self
+
+    def _on_elected(self) -> None:
+        log.info("manager elected leader")
+        self._is_leader.set()
+        self._start_controller()
+
+    def _on_lost(self) -> None:
+        log.info("manager lost leadership; pausing reconcile")
+        self._is_leader.clear()
+
+    def _start_controller(self) -> None:
+        t = threading.Thread(
+            target=self._controller_loop, daemon=True, name="controller"
+        )
+        self._threads.append(t)
+        t.start()
+
+    def _controller_loop(self) -> None:
+        # First tick marks readiness (the controller can serve its caches).
+        try:
+            self.controller.reconcile_once()
+        except Exception:
+            log.exception("initial reconcile failed")
+        self._ready.set()
+
+        stop_or_demoted = threading.Event()
+
+        def relay():
+            while not self._stop.is_set() and self._is_leader.is_set():
+                if self._stop.wait(0.2):
+                    break
+            stop_or_demoted.set()
+
+        relay_t = threading.Thread(target=relay, daemon=True)
+        relay_t.start()
+        self.controller.run(stop_or_demoted, self.cfg.tick_interval_s)
+
+    def run_forever(self, stop: threading.Event | None = None) -> None:
+        """Block until ``stop`` (or self.stop()) — mgr.Start parity."""
+        ext = stop or threading.Event()
+        while not self._stop.is_set() and not ext.is_set():
+            ext.wait(0.5)
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._is_leader.clear()
+        if self._lease is not None:
+            self._lease.stop()
+        for t in self._threads:
+            t.join(timeout=10)
+        self.health_server.shutdown()
+        self.metrics_server.shutdown()
+        if self.store_server is not None:
+            self.store_server.shutdown()
+
+
+def load_token(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read().strip()

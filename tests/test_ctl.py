@@ -1,0 +1,92 @@
+"""CLI tests (in-process): apply/get/delete against a served store."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeinfer_tpu import ctl
+from kubeinfer_tpu.controlplane.httpstore import StoreServer
+from kubeinfer_tpu.controlplane.store import Store
+
+
+@pytest.fixture()
+def served():
+    store = Store()
+    server = StoreServer(store, port=0).start()
+    try:
+        yield store, server.address
+    finally:
+        server.shutdown()
+
+
+def write_manifest(tmp_path, text: str) -> str:
+    p = tmp_path / "m.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+SVC = """
+apiVersion: ai.kubeinfer-tpu.io/v1
+kind: LLMService
+metadata:
+  name: cli-svc
+spec:
+  model: org/model
+  replicas: 2
+  cacheStrategy: shared
+"""
+
+
+def test_apply_create_then_configure(served, tmp_path, capsys):
+    _, addr = served
+    f = write_manifest(tmp_path, SVC)
+    assert ctl.main(["--store", addr, "apply", "-f", f]) == 0
+    assert "created" in capsys.readouterr().out
+
+    # re-apply with a spec change: update-in-place, status preserved
+    f2 = write_manifest(tmp_path, SVC.replace("replicas: 2", "replicas: 5"))
+    assert ctl.main(["--store", addr, "apply", "-f", f2]) == 0
+    assert "configured" in capsys.readouterr().out
+    assert ctl.main(["--store", addr, "get", "llmservice", "cli-svc",
+                     "-o", "json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["spec"]["replicas"] == 5
+
+
+def test_apply_multi_document(served, tmp_path, capsys):
+    _, addr = served
+    two = SVC + "---" + SVC.replace("cli-svc", "cli-svc-2")
+    f = write_manifest(tmp_path, two)
+    assert ctl.main(["--store", addr, "apply", "-f", f]) == 0
+    out = capsys.readouterr().out
+    assert out.count("created") == 2
+
+
+def test_apply_invalid_spec_fails(served, tmp_path, capsys):
+    _, addr = served
+    f = write_manifest(tmp_path, SVC.replace("org/model", '""'))
+    assert ctl.main(["--store", addr, "apply", "-f", f]) == 1
+    assert "spec.model is required" in capsys.readouterr().err
+
+
+def test_get_table_and_delete(served, tmp_path, capsys):
+    _, addr = served
+    f = write_manifest(tmp_path, SVC)
+    ctl.main(["--store", addr, "apply", "-f", f])
+    capsys.readouterr()
+
+    assert ctl.main(["--store", addr, "get", "llmservices"]) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "cli-svc" in out and "org/model" in out
+
+    assert ctl.main(["--store", addr, "delete", "llmservice", "cli-svc"]) == 0
+    capsys.readouterr()
+    assert ctl.main(["--store", addr, "get", "llmservice", "cli-svc"]) == 1
+
+
+def test_get_unknown_kind_exits(served):
+    _, addr = served
+    with pytest.raises(SystemExit):
+        ctl.main(["--store", addr, "get", "frobnicators"])

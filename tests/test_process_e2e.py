@@ -1,0 +1,185 @@
+"""Process-level e2e: real manager + agent OS processes, CLI-applied CR.
+
+The reference's e2e tier builds the manager image, deploys it to a Kind
+cluster, applies a sample CR, and scrapes the secured /metrics endpoint
+with a token (test/e2e/e2e_test.go:48-337). This is the same story without
+a container runtime: spawn ``python -m kubeinfer_tpu.manager`` and two
+``python -m kubeinfer_tpu.agent`` processes, apply a sample YAML via
+``python -m kubeinfer_tpu.ctl``, and assert the service reaches Running,
+the metrics endpoint enforces its token, and SIGTERM shuts everything
+down cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLE = os.path.join(REPO, "deploy", "samples", "llmservice_cache.yaml")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(pred, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def http_get(url: str, token: str = "") -> tuple[int, str]:
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, ""
+    except OSError:
+        return 0, ""  # not up yet
+
+
+@pytest.fixture()
+def subprocess_env(tmp_path):
+    env = dict(os.environ)
+    # subprocesses must not touch the experimental axon TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_manager_agents_cli_end_to_end(tmp_path, subprocess_env):
+    token_file = tmp_path / "token"
+    token_file.write_text("e2e-secret\n")
+
+    store_port, metrics_port, health_port = free_port(), free_port(), free_port()
+    store_addr = f"http://127.0.0.1:{store_port}"
+    procs: list[subprocess.Popen] = []
+    try:
+        manager = subprocess.Popen(
+            [
+                sys.executable, "-m", "kubeinfer_tpu.manager",
+                "--store-bind-address", f"127.0.0.1:{store_port}",
+                "--metrics-bind-address", f"127.0.0.1:{metrics_port}",
+                "--health-probe-bind-address", f"127.0.0.1:{health_port}",
+                "--auth-token-file", str(token_file),
+                "--tick-interval", "0.2",
+                "--node-ttl", "10",
+            ],
+            env=subprocess_env, cwd=REPO,
+        )
+        procs.append(manager)
+
+        # probes come up before the first reconcile finishes
+        wait_until(
+            lambda: http_get(f"http://127.0.0.1:{health_port}/healthz")[0] == 200,
+            60, "manager /healthz",
+        )
+        wait_until(
+            lambda: http_get(f"http://127.0.0.1:{health_port}/readyz")[0] == 200,
+            60, "manager /readyz",
+        )
+
+        for i in range(2):
+            agent_env = dict(subprocess_env)
+            agent_env.update(
+                NODE_NAME=f"node-{i}",
+                STORE_ADDR=store_addr,
+                STORE_TOKEN_FILE=str(token_file),
+                MODEL_PATH=str(tmp_path / f"models-{i}"),
+                GPU_CAPACITY="8",
+                GPU_MEMORY="16Gi",
+                HEARTBEAT_INTERVAL_S="0.3",
+                KUBEINFER_DOWNLOADER="mock",
+                LEASE_DURATION_S="2",
+                LEASE_RENEW_S="1",
+                LEASE_RETRY_S="0.3",
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "kubeinfer_tpu.agent"],
+                env=agent_env, cwd=REPO,
+            ))
+
+        store = RemoteStore(store_addr, token="e2e-secret")
+        wait_until(lambda: len(store.list("Node")) == 2, 60, "2 node heartbeats")
+
+        # apply the sample CR through the CLI binary
+        apply = subprocess.run(
+            [
+                sys.executable, "-m", "kubeinfer_tpu.ctl",
+                "--store", store_addr, "--token-file", str(token_file),
+                "apply", "-f", SAMPLE,
+            ],
+            env=subprocess_env, cwd=REPO, capture_output=True, text=True,
+            timeout=60,
+        )
+        assert apply.returncode == 0, apply.stderr
+        assert "created" in apply.stdout
+
+        def running() -> bool:
+            try:
+                svc = store.get("LLMService", "llm-cache-demo")
+            except (KeyError, OSError):
+                return False
+            return svc["status"]["phase"] == "Running"
+
+        wait_until(running, 90, "LLMService phase Running")
+
+        svc = store.get("LLMService", "llm-cache-demo")
+        assert svc["status"]["availableReplicas"] == 3
+        assert all(svc["status"]["placements"])
+        assert svc["status"]["cacheCoordinator"]  # a coordinator was elected
+
+        # CLI table output
+        get = subprocess.run(
+            [
+                sys.executable, "-m", "kubeinfer_tpu.ctl",
+                "--store", store_addr, "--token-file", str(token_file),
+                "get", "llmservices",
+            ],
+            env=subprocess_env, cwd=REPO, capture_output=True, text=True,
+            timeout=60,
+        )
+        assert get.returncode == 0
+        assert "llm-cache-demo" in get.stdout and "Running" in get.stdout
+
+        # secured metrics endpoint (ref e2e_test.go:176-267 parity)
+        code, _ = http_get(f"http://127.0.0.1:{metrics_port}/metrics")
+        assert code == 401
+        code, body = http_get(
+            f"http://127.0.0.1:{metrics_port}/metrics", token="e2e-secret"
+        )
+        assert code == 200
+        assert "kubeinfer_llmservice_total 1" in body
+        assert "kubeinfer_reconcile_total" in body
+        assert "kubeinfer_solve_duration_seconds" in body
+
+        # clean shutdown on SIGTERM (ref signal handling parity)
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=30) == 0
+        procs.clear()
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait(timeout=10)
