@@ -1,0 +1,37 @@
+# Build the kubeinfer_tpu image (manager, agent, and ctl in one image —
+# the binary is selected by the container command).
+# Parity target: reference Dockerfile:1-31 — multi-stage build, minimal
+# nonroot runtime image.
+
+# ---- build stage: compile the native tier -------------------------------
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+# ---- runtime stage ------------------------------------------------------
+FROM python:3.12-slim
+# CPU jax is enough for the manager's solver off-TPU; on TPU hosts the
+# platform's libtpu-enabled jax is mounted/installed instead.
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY kubeinfer_tpu/ kubeinfer_tpu/
+COPY deploy/samples/ deploy/samples/
+COPY --from=build /src/native/libkubeinfer_native.so native/libkubeinfer_native.so
+RUN pip install --no-cache-dir --no-deps .
+
+# nonroot runtime (reference uses distroless nonroot, Dockerfile:26-31)
+RUN useradd --uid 65532 --no-create-home nonroot && \
+    mkdir -p /models && chown nonroot /models
+USER 65532
+
+# manager by default; agent containers override with
+#   command: ["python", "-m", "kubeinfer_tpu.agent"]
+ENTRYPOINT ["python", "-m", "kubeinfer_tpu.manager"]
+CMD ["--store-bind-address", "0.0.0.0:18080", \
+     "--metrics-bind-address", "0.0.0.0:18081", \
+     "--health-probe-bind-address", "0.0.0.0:18082"]

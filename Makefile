@@ -1,0 +1,48 @@
+# Developer entrypoints (reference Makefile parity: test / test-e2e /
+# lint / build / run targets, Makefile:44-250).
+
+PY ?= python
+# Tests run on a forced virtual CPU mesh (tests/conftest.py); bench runs on
+# whatever JAX backend is live (real TPU chip if present).
+
+.PHONY: all native test test-e2e bench bench-quick bench-full lint \
+        run-manager run-agent docker-build clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_process_e2e.py
+
+test-e2e: native
+	$(PY) -m pytest tests/test_process_e2e.py tests/test_e2e_slice.py -q -x
+
+bench: native
+	$(PY) bench.py
+
+bench-quick: native
+	$(PY) bench.py --quick
+
+bench-full: native
+	$(PY) bench.py --full
+
+lint:
+	$(PY) -m compileall -q kubeinfer_tpu tests bench.py __graft_entry__.py
+
+# local quickstart helpers (see README)
+run-manager:
+	$(PY) -m kubeinfer_tpu.manager --tick-interval 0.5
+
+run-agent:
+	STORE_ADDR=http://127.0.0.1:18080 KUBEINFER_DOWNLOADER=mock \
+	MODEL_PATH=/tmp/kubeinfer-models NODE_NAME=$${NODE_NAME:-node-0} \
+	$(PY) -m kubeinfer_tpu.agent
+
+docker-build:
+	docker build -t kubeinfer-tpu:latest .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

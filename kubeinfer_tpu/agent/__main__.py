@@ -37,7 +37,7 @@ import threading
 from kubeinfer_tpu.agent.coordinator import hub_download, mock_download
 from kubeinfer_tpu.agent.node_agent import NodeAgent
 from kubeinfer_tpu.api.types import parse_quantity
-from kubeinfer_tpu.controlplane.httpstore import RemoteStore
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore, load_token
 
 
 def main() -> int:
@@ -51,11 +51,8 @@ def main() -> int:
     if not store_addr:
         log.error("STORE_ADDR is required (control-plane store URL)")
         return 2
-    token = ""
     token_file = os.environ.get("STORE_TOKEN_FILE", "")
-    if token_file:
-        with open(token_file, "r", encoding="utf-8") as f:
-            token = f.read().strip()
+    token = load_token(token_file) if token_file else ""
 
     node_name = os.environ.get("NODE_NAME", socket.gethostname())
     model_root = os.environ.get("MODEL_PATH", "/models")

@@ -34,14 +34,13 @@ config/crd/bases/ai.ruijie.io_llmservices.yaml:45-60).
 from __future__ import annotations
 
 import collections
-import hmac
 import json
 import logging
 import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any
 
 from kubeinfer_tpu.api.types import LLMService, ValidationError
@@ -52,10 +51,17 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
     WatchEvent,
 )
+from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, token_matches
 
 log = logging.getLogger(__name__)
 
 EVENT_LOG_SIZE = 65536  # ring of recent events served to long-pollers
+
+
+def load_token(path: str) -> str:
+    """Read a bearer token from a file (one copy for manager/agent/ctl)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read().strip()
 
 
 class StoreServer:
@@ -77,41 +83,31 @@ class StoreServer:
 
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):  # route to logging, not stderr
-                log.debug("httpstore: " + fmt, *args)
-
+        class Handler(BaseEndpointHandler):
             def _send(self, code: int, body: dict | list) -> None:
-                data = json.dumps(body).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self.respond(code, "application/json", json.dumps(body))
 
             def _authed(self) -> bool:
                 if not server._token:
                     return True
                 got = self.headers.get("Authorization", "")
-                return hmac.compare_digest(got, f"Bearer {server._token}")
+                return token_matches(got, server._token)
 
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
             def _drop_body(self) -> None:
-                # Responding without consuming the request body desyncs
-                # HTTP/1.1 keep-alive: the unread bytes would be parsed as
-                # the next request line by pooled clients.
-                n = int(self.headers.get("Content-Length", 0))
-                if n:
-                    self.rfile.read(n)
+                self.drop_body()
 
             def _route(self, method: str) -> None:
                 parsed = urllib.parse.urlparse(self.path)
-                parts = [p for p in parsed.path.split("/") if p]
+                # unquote AFTER splitting: %2F inside a name must not
+                # become a path separator
+                parts = [
+                    urllib.parse.unquote(p)
+                    for p in parsed.path.split("/") if p
+                ]
                 q = urllib.parse.parse_qs(parsed.query)
                 if parts == ["healthz"]:
                     self._drop_body()
@@ -256,12 +252,19 @@ class StoreServer:
         self, since: int, timeout: float, kind: str | None, ns: str | None
     ) -> tuple[list[WatchEvent], int]:
         def matching() -> list[WatchEvent]:
-            return [
-                e for e in self._events
-                if e.resource_version > since
-                and (kind is None or e.kind == kind)
-                and (ns is None or e.namespace == ns)
-            ]
+            # The ring is rv-ordered and pollers sit near the tip: scan
+            # from the right and stop at the first already-seen event,
+            # so each poll is O(new events), not O(ring).
+            out: list[WatchEvent] = []
+            for e in reversed(self._events):
+                if e.resource_version <= since:
+                    break
+                if (kind is None or e.kind == kind) and (
+                    ns is None or e.namespace == ns
+                ):
+                    out.append(e)
+            out.reverse()
+            return out
 
         with self._events_cond:
             evs = matching()
@@ -329,23 +332,39 @@ class RemoteStore:
 
     # -- Store interface --------------------------------------------------
 
+    @staticmethod
+    def _seg(s: str) -> str:
+        # names/namespaces/kinds are data, not path structure: a name like
+        # "a/b" must travel as one segment ("a%2Fb")
+        return urllib.parse.quote(s, safe="")
+
     def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
-        return self._req("POST", f"/apis/{kind}", obj)
+        return self._req("POST", f"/apis/{self._seg(kind)}", obj)
 
     def get(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
-        return self._req("GET", f"/apis/{kind}/{namespace}/{name}")
+        return self._req(
+            "GET",
+            f"/apis/{self._seg(kind)}/{self._seg(namespace)}/{self._seg(name)}",
+        )
 
     def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
         meta = obj.get("metadata", {})
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")
-        return self._req("PUT", f"/apis/{kind}/{ns}/{name}", obj)
+        return self._req(
+            "PUT",
+            f"/apis/{self._seg(kind)}/{self._seg(ns)}/{self._seg(name)}",
+            obj,
+        )
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
-        self._req("DELETE", f"/apis/{kind}/{namespace}/{name}")
+        self._req(
+            "DELETE",
+            f"/apis/{self._seg(kind)}/{self._seg(namespace)}/{self._seg(name)}",
+        )
 
     def list(self, kind: str, namespace: str | None = None) -> list[dict[str, Any]]:
-        path = f"/apis/{kind}"
+        path = f"/apis/{self._seg(kind)}"
         if namespace is not None:
             path += f"?namespace={urllib.parse.quote(namespace)}"
         return self._req("GET", path)

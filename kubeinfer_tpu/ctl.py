@@ -21,8 +21,12 @@ import sys
 
 import yaml
 
-from kubeinfer_tpu.controlplane.httpstore import RemoteStore
-from kubeinfer_tpu.controlplane.store import ConflictError, NotFoundError
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore, load_token
+from kubeinfer_tpu.controlplane.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
 
 # kubectl-style aliases → store kinds
 KIND_ALIASES = {
@@ -66,7 +70,7 @@ def _apply_one(store: RemoteStore, doc: dict) -> str:
             try:
                 store.create(kind, doc)
                 return "created"
-            except ConflictError:
+            except AlreadyExistsError:
                 continue  # raced another creator; re-read and update
         current["spec"] = doc.get("spec", {})
         if "labels" in meta:
@@ -205,10 +209,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    token = ""
-    if args.token_file:
-        with open(args.token_file, "r", encoding="utf-8") as f:
-            token = f.read().strip()
+    token = load_token(args.token_file) if args.token_file else ""
     store = RemoteStore(args.store, token=token)
     return args.fn(store, args)
 

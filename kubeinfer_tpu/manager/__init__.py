@@ -20,19 +20,28 @@ Deliberate differences:
 
 from __future__ import annotations
 
-import hmac
 import logging
+import os
+import secrets
+import socket
 import threading
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Callable
 
 from kubeinfer_tpu import metrics
 from kubeinfer_tpu.controller.reconciler import Controller
-from kubeinfer_tpu.controlplane.httpstore import RemoteStore, StoreServer
+from kubeinfer_tpu.controlplane.httpstore import (
+    RemoteStore,
+    StoreServer,
+    load_token,
+)
 from kubeinfer_tpu.controlplane.store import Store
 from kubeinfer_tpu.coordination.lease import LeaseManager
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, token_matches
+
+__all__ = ["Manager", "ManagerConfig", "EndpointServer", "load_token"]
 
 log = logging.getLogger(__name__)
 
@@ -50,38 +59,23 @@ class EndpointServer:
     def __init__(self, host: str, port: int,
                  routes: dict[str, Callable[[], tuple[int, str, str]]],
                  token: str = "", open_paths: tuple[str, ...] = ()) -> None:
-        srv = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):
-                log.debug("endpoint: " + fmt, *args)
-
+        class Handler(BaseEndpointHandler):
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 handler = routes.get(path)
                 if handler is None:
-                    self._respond(404, "text/plain", "not found\n")
+                    self.respond(404, "text/plain", "not found\n")
                     return
                 if token and path not in open_paths:
                     got = self.headers.get("Authorization", "")
-                    if not hmac.compare_digest(got, f"Bearer {token}"):
-                        self._respond(401, "text/plain", "unauthorized\n")
+                    if not token_matches(got, token):
+                        self.respond(401, "text/plain", "unauthorized\n")
                         return
                 try:
-                    self._respond(*handler())
+                    self.respond(*handler())
                 except Exception as e:
                     log.exception("endpoint %s failed", path)
-                    self._respond(500, "text/plain", f"error: {e}\n")
-
-            def _respond(self, code: int, ctype: str, body: str):
-                data = body.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                    self.respond(500, "text/plain", f"error: {e}\n")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -215,10 +209,17 @@ class Manager:
                 timing_kw = dict(
                     duration_s=d, renew_interval_s=rn, retry_interval_s=rt
                 )
+            # Default identity must be unique across HOSTS AND PROCESSES
+            # (two managers agreeing on an identity = both lead =
+            # split-brain); hostname+pid+random nonce guarantees it the
+            # way the reference's pod name does.
+            identity = self.cfg.identity or (
+                f"manager-{socket.gethostname()}-{os.getpid()}-"
+                f"{secrets.token_hex(4)}"
+            )
             self._lease = LeaseManager(
                 self.store, self.cfg.namespace, MANAGER_LEASE,
-                identity=self.cfg.identity or f"manager-{id(self):x}",
-                clock=self._clock, **timing_kw,
+                identity=identity, clock=self._clock, **timing_kw,
             )
             self._lease.start(self._on_elected, self._on_lost)
         else:
@@ -280,8 +281,3 @@ class Manager:
         self.metrics_server.shutdown()
         if self.store_server is not None:
             self.store_server.shutdown()
-
-
-def load_token(path: str) -> str:
-    with open(path, "r", encoding="utf-8") as f:
-        return f.read().strip()

@@ -49,6 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect", action="store_true",
                    help="enable manager leader election (for HA managers "
                         "sharing one store)")
+    p.add_argument("--identity", default="",
+                   help="leader-election holder identity (default: "
+                        "hostname-pid-nonce)")
     p.add_argument("--namespace", default="default")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
@@ -77,6 +80,7 @@ def main(argv: list[str] | None = None) -> int:
         tick_interval_s=args.tick_interval,
         node_ttl_s=args.node_ttl,
         leader_elect=args.leader_elect,
+        identity=args.identity,
         namespace=args.namespace,
     )
 

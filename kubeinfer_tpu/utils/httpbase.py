@@ -1,0 +1,53 @@
+"""Shared plumbing for the framework's hand-rolled HTTP endpoints.
+
+Both the control-plane store server and the manager's probe/metrics
+endpoints speak HTTP/1.1 with static-bearer-token auth; this module is the
+single home for the auth comparison and response writing so a hardening
+fix lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+from http.server import BaseHTTPRequestHandler
+
+log = logging.getLogger(__name__)
+
+
+def token_matches(header_value: str, token: str) -> bool:
+    """Constant-time bearer-token check.
+
+    Bytes comparison: ``hmac.compare_digest`` raises TypeError on
+    non-ASCII *str* inputs, which would kill the connection thread without
+    a response; encoding first makes any unicode header merely unequal.
+    """
+    return hmac.compare_digest(
+        header_value.encode("utf-8", "surrogateescape"),
+        f"Bearer {token}".encode("utf-8"),
+    )
+
+
+class BaseEndpointHandler(BaseHTTPRequestHandler):
+    """HTTP/1.1 handler base: logging redirect + framed responses."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        log.debug("http: " + fmt, *args)
+
+    def respond(self, code: int, ctype: str, payload: bytes | str) -> None:
+        data = payload.encode() if isinstance(payload, str) else payload
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def drop_body(self) -> None:
+        """Consume the request body before an early error response —
+        unread bytes desync HTTP/1.1 keep-alive (the client's next
+        request line would be parsed out of the stale body)."""
+        n = int(self.headers.get("Content-Length", 0))
+        if n:
+            self.rfile.read(n)
