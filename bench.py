@@ -6,9 +6,17 @@ vs_baseline = serial native C++ scorer p50 / JAX p50 (speedup; the
 reference publishes no measured numbers of its own — SURVEY.md §6 — so the
 mandated serial scorer is the anchor).
 
-End-to-end means encode + host->device + solve + readback: the latency a
-reconcile tick actually pays. ``--full`` additionally reports the other
-BASELINE.json configs in extras.
+End-to-end means pack + host->device + solve + readback: the latency a
+reconcile tick actually pays. Under a remote PJRT attachment (the axon
+tunnel this box uses) every dispatch+readback pays a ~90-130ms transport
+round trip that no software change can remove; ``device_solve_ms`` —
+measured by differencing two on-device solve chains, which cancels the
+transport term exactly — is the number that predicts local-attachment
+latency, where dispatch costs ~0.1ms.
+
+The default run also covers the BASELINE.json config sweep (32x8 /
+1kx128 / 10kx1k gang / preemption-churn / 50k soak) in extras;
+``--quick`` trims reps and skips the sweep.
 """
 
 from __future__ import annotations
@@ -45,29 +53,23 @@ def build_request(J, N, seed=0, gang_fraction=0.0):
 
 
 def time_backend(backend, req, reps):
-    times = []
+    times, encodes = [], []
     placed = 0
     for _ in range(reps):
         res = backend.solve(req)
         times.append(res.solve_ms)
+        encodes.append(res.extras.get("encode_ms", 0.0))
         placed = res.placed
     return {
         "p50_ms": statistics.median(times),
         "p95_ms": sorted(times)[max(int(len(times) * 0.95) - 1, 0)],
+        "encode_p50_ms": statistics.median(encodes),
         "placed": placed,
     }
 
 
-def device_solve_ms(req, k=8, reps=3):
-    """Device-compute-only per-solve time: K data-dependent solves chained
-    inside ONE dispatch (lax.scan), minus the measured dispatch floor.
-
-    Isolates solver compute from per-dispatch transport. On local TPU
-    hardware dispatch is ~0.1ms and e2e ≈ this number; under a remote
-    PJRT relay (the axon tunnel) each dispatch+readback costs ~90ms of
-    transport that no software change can remove, so e2e and this number
-    diverge by exactly that constant.
-    """
+def _chained_solver(req, k):
+    """jit fn running k data-dependent solves in ONE dispatch."""
     import jax
     import jax.numpy as jnp
     from dataclasses import replace
@@ -91,7 +93,7 @@ def device_solve_ms(req, k=8, reps=3):
     def chained(problem):
         def body(carry, _):
             # real data dependency between iterations so XLA can't CSE the
-            # K solves into one; 1e-9 chips is semantically invisible
+            # k solves into one; 1e-9 chips is semantically invisible
             nodes = replace(
                 problem.nodes, gpu_free=problem.nodes.gpu_free + carry
             )
@@ -100,31 +102,95 @@ def device_solve_ms(req, k=8, reps=3):
 
         return jax.lax.scan(body, jnp.float32(0.0), None, length=k)
 
+    return chained, p
+
+
+def device_solve_ms(req, k_short=4, k_long=20, reps=5):
+    """Pure device-compute per-solve time via chain differencing.
+
+    Times a k_short-solve chain and a k_long-solve chain (each ONE
+    dispatch+readback) and reports (t_long - t_short) / (k_long -
+    k_short): the transport round trip appears identically in both and
+    cancels exactly, unlike floor-subtraction (transport jitter is
+    ~±20ms here, larger than the whole signal).
+    Also returns the median one-dispatch floor for reporting.
+    """
+    import jax
+
+    short, p = _chained_solver(req, k_short)
+    long_, _ = _chained_solver(req, k_long)
+
     @jax.jit
     def floor_probe(x):
         return x * 2
 
     tiny = jax.device_put(np.ones(8, np.float32))
     np.asarray(floor_probe(tiny))
-    np.asarray(chained(p)[1])  # compile
+    np.asarray(short(p)[1])
+    np.asarray(long_(p)[1])  # compile all
 
-    floors, totals = [], []
+    floors, shorts, longs = [], [], []
     for _ in range(reps):
         t0 = time.perf_counter()
         np.asarray(floor_probe(tiny))
         floors.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        np.asarray(chained(p)[1])
-        totals.append(time.perf_counter() - t0)
-    floor = statistics.median(floors)
-    total = statistics.median(totals)
-    return max((total - floor) / k, 0.0) * 1e3, floor * 1e3
+        np.asarray(short(p)[1])
+        shorts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(long_(p)[1])
+        longs.append(time.perf_counter() - t0)
+    per_solve = (statistics.median(longs) - statistics.median(shorts)) / (
+        k_long - k_short
+    )
+    floor_p50 = statistics.median(floors)
+    floor_jitter = max(floors) - min(floors)
+    return max(per_solve, 0.0) * 1e3, floor_p50 * 1e3, floor_jitter * 1e3
+
+
+def churn_bench(backend, J=10_000, N=1_000, steps=8, churn_frac=0.1, seed=5):
+    """BASELINE config 4: re-solve under arrival/departure churn with
+    incumbents. Measures per-re-solve latency and placement stability
+    (fraction of surviving incumbents that moved — the move-hysteresis
+    cost term exists to keep this near zero)."""
+    rng = np.random.default_rng(seed)
+    req = build_request(J, N, seed=seed)
+    res = backend.solve(req)
+    current = res.assignment.copy()
+
+    times, moved_fracs = [], []
+    for _ in range(steps):
+        # 10% of jobs depart (their rows are replaced by fresh arrivals
+        # with no incumbent placement)
+        departed = rng.random(J) < churn_frac
+        current[departed] = -1
+        req.job_gpu[departed] = rng.integers(1, 8, departed.sum())
+        req.job_mem_gib[departed] = rng.integers(4, 64, departed.sum())
+        req.job_priority[departed] = rng.integers(0, 8, departed.sum())
+        req.job_current_node = current
+        res = backend.solve(req)
+        times.append(res.solve_ms)
+        survivors = ~departed & (current >= 0)
+        if survivors.any():
+            moved_fracs.append(
+                float(
+                    (res.assignment[survivors] != current[survivors]).mean()
+                )
+            )
+        current = res.assignment.copy()
+    return {
+        "p50_ms": statistics.median(times),
+        "moved_frac": round(statistics.median(moved_fracs), 4),
+        "placed": int(res.placed),
+    }
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true", help="fewer reps, smaller sweep")
-    ap.add_argument("--full", action="store_true", help="run all BASELINE configs")
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer reps, skip the config sweep")
+    ap.add_argument("--full", action="store_true",
+                    help="(kept for compat; the sweep now runs by default)")
     args = ap.parse_args()
     reps = 5 if args.quick else 20
 
@@ -143,7 +209,10 @@ def main() -> None:
 
     jax_stats = time_backend(jax_backend, req, reps)
     native_stats = time_backend(native, req, max(reps // 2, 3))
-    dev_ms, dispatch_floor_ms = device_solve_ms(req, k=4 if args.quick else 8)
+    dev_ms, floor_ms, floor_jitter_ms = device_solve_ms(
+        req, k_short=2 if args.quick else 4, k_long=10 if args.quick else 20,
+        reps=3 if args.quick else 5,
+    )
 
     extras = {
         "device": str(device),
@@ -151,13 +220,18 @@ def main() -> None:
         "jax_p95_ms": round(jax_stats["p95_ms"], 3),
         "native_p50_ms": round(native_stats["p50_ms"], 3),
         "device_solve_ms": round(dev_ms, 3),
-        "dispatch_floor_ms": round(dispatch_floor_ms, 3),
-        # e2e with the measured transport floor backed out: what the same
-        # backend pays on local (non-relayed) TPU hardware, where dispatch
-        # is ~0.1ms. The 50ms north-star budget is defined against local
+        "dispatch_floor_ms": round(floor_ms, 3),
+        # transport round-trip jitter across identical tiny dispatches:
+        # the e2e p95-p50 gap is this relay noise, not solver variance
+        # (device_solve_ms differencing is immune to it)
+        "transport_jitter_ms": round(floor_jitter_ms, 3),
+        # what the same backend pays on local (non-relayed) TPU hardware,
+        # where dispatch is ~0.1ms: measured host pack time + device
+        # solve. The 50ms north-star budget is defined against local
         # attachment; the relay floor alone exceeds it.
-        "e2e_minus_dispatch_ms": round(
-            max(jax_stats["p50_ms"] - dispatch_floor_ms, 0.0), 3
+        "pack_p50_ms": round(jax_stats["encode_p50_ms"], 3),
+        "local_attach_e2e_ms": round(
+            jax_stats["encode_p50_ms"] + dev_ms, 3
         ),
         "device_vs_native": round(native_stats["p50_ms"] / max(dev_ms, 1e-9), 2),
         "placed": jax_stats["placed"],
@@ -167,7 +241,8 @@ def main() -> None:
         "device_decisions_per_sec": round(10_000 / max(dev_ms / 1e3, 1e-9)),
     }
 
-    if args.full:
+    if not args.quick:
+        # BASELINE.json config sweep (all five, persisted every run)
         for label, J, N, gang in (
             ("32x8", 32, 8, 0.0),
             ("1kx128", 1_000, 128, 0.0),
@@ -179,6 +254,10 @@ def main() -> None:
             s = time_backend(jax_backend, r, max(reps // 2, 3))
             extras[f"cfg_{label}_p50_ms"] = round(s["p50_ms"], 3)
             extras[f"cfg_{label}_placed"] = s["placed"]
+        churn = churn_bench(jax_backend)
+        extras["cfg_churn_p50_ms"] = round(churn["p50_ms"], 3)
+        extras["cfg_churn_moved_frac"] = churn["moved_frac"]
+        extras["cfg_churn_placed"] = churn["placed"]
 
     print(
         json.dumps(

@@ -160,6 +160,24 @@ def _fit_cost(
     )
 
 
+def _fence_minrank(
+    gpu_free: jax.Array,  # [N]
+    mem_free: jax.Array,  # [N]
+    gpu_demand: jax.Array,  # [J]
+    mem_demand: jax.Array,  # [J]
+    rankf_eff: jax.Array,  # [J]
+) -> jax.Array:
+    """[N] per-node fence minimum: the best (lowest) priority rank among
+    unplaced jobs that find the node feasible. Vector inputs only, so XLA
+    fuses the [N, J] broadcast into the reduction without materializing
+    it; shared by the jnp and Pallas bid paths (the Pallas kernel tiles J
+    and so cannot compute a full-J reduction per node itself)."""
+    feas = (gpu_demand[None, :] <= gpu_free[:, None] + _EPS) & (
+        mem_demand[None, :] <= mem_free[:, None] + _EPS
+    )
+    return jnp.min(jnp.where(feas, rankf_eff[None, :], RANK_INF), axis=1)
+
+
 def _round_bids_jnp(
     S: jax.Array,  # [N, J] resident cost field
     u: jax.Array,  # [N] live best-fit pressure
@@ -168,6 +186,7 @@ def _round_bids_jnp(
     gpu_demand: jax.Array,  # [J]
     mem_demand: jax.Array,  # [J]
     rankf_eff: jax.Array,  # [J] fence rank; RANK_INF = may not bid
+    minrank: jax.Array,  # [N] fence minimum (see _fence_minrank)
     num_nodes: int,
     q_lo: float,
     q_scale: float,
@@ -192,7 +211,6 @@ def _round_bids_jnp(
     feas = (gpu_demand[None, :] <= gpu_free[:, None] + _EPS) & (
         mem_demand[None, :] <= mem_free[:, None] + _EPS
     )
-    minrank = jnp.min(jnp.where(feas, rankf_eff[None, :], RANK_INF), axis=1)
     allowed = (
         feas
         & (rankf_eff[None, :] <= minrank[:, None])
@@ -277,8 +295,9 @@ def _dense_accept(
     A node whose bidders' total demand fits its remaining capacity accepts
     ALL of them — the common case once tie-noise has spread bids. A
     contested node accepts only its single best bidder this pass (lowest
-    ``accept_key``: priority rank, then demand ascending so one oversized
-    bidder can't hog the node, then job index for single-valuedness);
+    ``accept_key``: priority rank, then demand DESCENDING — the
+    first-fit-decreasing rule; see the key construction in solve_greedy —
+    then job index for single-valuedness);
     losers immediately retry their alternate node in the caller's
     second-chance pass and re-bid next round after that. The winner's
     demand is recovered by unpacking the job index from the reduced key —
@@ -384,20 +403,29 @@ def solve_greedy(
     crank = jnp.zeros((J,), jnp.int32).at[order_p].set(crank)
     rankf = jnp.where(jobs.valid, crank.astype(jnp.float32), RANK_INF)
 
-    # Tie-spreading field, sampled ONCE per solve: per-round threefry over
-    # [N, J] would dominate the round cost on TPU (RNG is ALU-bound while
-    # everything else here is HBM-bound). No per-round rotation either: the
-    # field already differs per (job, node), so conflict losers diverge to
-    # different second choices without it — and a [N, J] roll is a full HBM
-    # gather pass per round.
-    # Clipped to [-2, 6]: the raw gumbel tail would escape the static
-    # quantization bounds (q_lo/q_hi below) and saturate, collapsing those
-    # entries' tie-spread to node-index order. Clipping is monotone and
-    # touches <0.1% of samples.
-    base_noise = max(weights.noise, _MIN_TIE_NOISE) * jnp.clip(
-        jax.random.gumbel(jax.random.PRNGKey(0), (N, J), jnp.float32),
-        -2.0,
-        6.0,
+    # Tie-spreading field, sampled ONCE per solve: per-round noise over
+    # [N, J] would dominate the round cost on TPU. No per-round rotation
+    # either: the field already differs per (job, node), so conflict losers
+    # diverge to different second choices without it — and a [N, J] roll is
+    # a full HBM gather pass per round.
+    # The generator is a 2-mix integer hash (fmix-style), not threefry:
+    # tie-spreading needs decorrelation across (node, job), not
+    # cryptographic quality, and the hash is ~6 VPU ops/element vs
+    # threefry's ~100. Output is uniform in [0, 1): bounded by
+    # construction, so (unlike a gumbel) it cannot escape the static
+    # quantization bounds below.
+    _n = lax.broadcasted_iota(jnp.int32, (N, J), 0)
+    _j = lax.broadcasted_iota(jnp.int32, (N, J), 1)
+    _h = _n * jnp.int32(-1640531527) + _j * jnp.int32(40503)
+    _h = _h ^ (_h >> 13)
+    _h = _h * jnp.int32(-1274126529)
+    _h = _h ^ (_h >> 16)
+    # Spread over [-2, 6) — the clipped-gumbel support the weights/round
+    # count were tuned against (narrower spread measurably raises the
+    # round count: collisions among near-ties settle one per round).
+    base_noise = max(weights.noise, _MIN_TIE_NOISE) * (
+        (_h & jnp.int32(0x7FFFFF)).astype(jnp.float32) * (8.0 / float(1 << 23))
+        - 2.0
     )
 
     # Everything round-invariant folds into ONE resident node-major [N, J]
@@ -431,6 +459,8 @@ def solve_greedy(
     cost_bits = 31 - node_idx_bits
     fit_sum = weights.fit_gpu + weights.fit_mem
     noise_scale = max(weights.noise, _MIN_TIE_NOISE)
+    # noise is uniform in [-2, 6) * scale: bounds are exact, not tail
+    # estimates
     q_lo = -fit_sum - 2.0 * noise_scale
     q_hi = (
         weights.cache + weights.move + weights.topology
@@ -442,7 +472,12 @@ def solve_greedy(
     BIG = jnp.int32(0x7FFFFFFF)
 
     # Per-job accept key (round-invariant): priority rank, then demand
-    # ascending, then job index — see _dense_accept.
+    # DESCENDING, then job index — see _dense_accept. Descending is the
+    # first-fit-decreasing rule: a contested node goes to its largest
+    # bidder, because small losers nearly always fit somewhere else while
+    # a stranded large job often fits nowhere (an 8-chip job losing its
+    # only whole-idle node to a 1-chip job is unrecoverable; the reverse
+    # is a shrug).
     j_idx_bits = max((J - 1).bit_length(), 1)
     rank_bits = 31 - j_idx_bits - 4
     rank_c = jnp.clip(prank, 0, (1 << rank_bits) - 1)
@@ -450,7 +485,7 @@ def solve_greedy(
     demand_q = jnp.clip(jobs.gpu_demand * (15.0 / dmax), 0, 15).astype(jnp.int32)
     accept_key = (
         (rank_c << (4 + j_idx_bits))
-        | (demand_q << j_idx_bits)
+        | ((15 - demand_q) << j_idx_bits)
         | jnp.arange(J, dtype=jnp.int32)
     )
 
@@ -459,10 +494,10 @@ def solve_greedy(
 
         interp = accel == "interpret"
 
-        def round_bids(u, gf, mf, rankf_eff):
+        def round_bids(u, gf, mf, rankf_eff, minrank):
             return pk.bid_reduce_pallas(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
-                q_lo=q_lo, q_scale=q_scale, q_max=q_max,
+                minrank, q_lo=q_lo, q_scale=q_scale, q_max=q_max,
                 node_idx_bits=node_idx_bits, interpret=interp,
             )
 
@@ -472,10 +507,10 @@ def solve_greedy(
             )
     else:
 
-        def round_bids(u, gf, mf, rankf_eff):
+        def round_bids(u, gf, mf, rankf_eff, minrank):
             return _round_bids_jnp(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
-                N, q_lo, q_scale, q_max, node_idx_bits,
+                minrank, N, q_lo, q_scale, q_max, node_idx_bits,
             )
 
         accept_reduce = _accept_reduce_jnp
@@ -491,7 +526,10 @@ def solve_greedy(
         # need no separate unassigned input.
         rankf_eff = jnp.where(assigned < 0, rankf, RANK_INF)
         u = v_g * gpu_free + v_m * mem_free  # [N] live best-fit pressure
-        prim, alt = round_bids(u, gpu_free, mem_free, rankf_eff)
+        minrank = _fence_minrank(
+            gpu_free, mem_free, jobs.gpu_demand, jobs.mem_demand, rankf_eff
+        )
+        prim, alt = round_bids(u, gpu_free, mem_free, rankf_eff, minrank)
         has1 = prim != BIG
         choice1 = jnp.where(has1, prim & node_mask, N)
 

@@ -7,13 +7,19 @@ v5e — ~8 full HBM round-trips), because multi-consumer broadcast producers
 defeat reduction fusion. Here each round becomes:
 
 - ONE ``bid`` kernel: tiles the resident [N, J] cost field S through VMEM
-  (TILE_N=128 sublanes x J lanes), fusing feasibility, the per-node
+  (TILE_N=128 sublanes x TILE_J lanes), fusing feasibility, the per-node
   priority fence, static-bound cost quantization, and the packed
   (cost | node) i32 min — S is read from HBM exactly once per round and
-  nothing [N, J]-sized is ever written back.
+  nothing [N, J]-sized is ever written back. The J axis is tiled so VMEM
+  holds at most [128, 4096] f32 (4MB double-buffered) regardless of the
+  job bucket — the 50k-job soak shape would otherwise blow the 16MB VMEM
+  scoped limit. The fence minimum over ALL jobs (``minrank``) therefore
+  arrives as an input (it only reads vectors; the caller computes it as a
+  fused jnp reduction).
 - TWO ``accept`` kernel calls (first chance + second chance): per-node
   column reductions (bidder demand totals + fused-key winner) whose inputs
-  are four [J] vectors; the [TILE_N, J] broadcast lives only in VMEM.
+  are four [J] vectors; the [TILE_N, TILE_J] broadcast lives only in VMEM,
+  accumulating across J tiles (innermost grid dim, init at tile 0).
 
 The jnp reference implementations live in ``core.py`` (`_round_bids_jnp`,
 `_accept_reduce_jnp`) and remain the code path for CPU tests, sharded
@@ -35,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 128
+MAX_TILE_J = 4096  # [128, 4096] f32 = 2MB/block, 4MB double-buffered
 # Plain Python scalars: module-level jnp constants would be captured by the
 # kernel closures, which pallas_call rejects ("captures constants"). Packed
 # values are non-negative int32 (i31): Mosaic has no unsigned reductions.
@@ -45,22 +52,34 @@ _EPS = 1e-4
 RANK_INF = 1e9
 
 
+def _tile_j(J: int) -> int:
+    """Largest J tile that divides the bucket (buckets are 128-aligned;
+    >4096 buckets are all multiples of 2048)."""
+    if J <= MAX_TILE_J:
+        return J
+    for t in (MAX_TILE_J, 3072, 2048, 1536, 1024, 512, 384, 256, 128):
+        if J % t == 0:
+            return t
+    raise ValueError(f"no J tile divides {J}")
+
+
 def _bid_kernel(
-    d_ref,  # [1, J] f32 gpu demand
-    md_ref,  # [1, J] f32 mem demand
-    rankf_ref,  # [1, J] f32 fence rank, RANK_INF when may-not-bid
+    d_ref,  # [1, TILE_J] f32 gpu demand
+    md_ref,  # [1, TILE_J] f32 mem demand
+    rankf_ref,  # [1, TILE_J] f32 fence rank, RANK_INF when may-not-bid
     gf_ref,  # [TILE_N, 1] f32 gpu free (invalid nodes pre-folded to -1)
     mf_ref,  # [TILE_N, 1] f32 mem free
     u_ref,  # [TILE_N, 1] f32 live best-fit pressure
-    s_ref,  # [TILE_N, J] f32 resident cost field tile
-    out_ref,  # [8, J] i32 per-16-node-group packed (cost | node) mins
+    minrank_ref,  # [TILE_N, 1] f32 per-node fence minimum (over ALL jobs)
+    s_ref,  # [TILE_N, TILE_J] f32 resident cost field tile
+    out_ref,  # [8, TILE_J] i32 per-16-node-group packed (cost | node) mins
     *,
     q_lo: float,
     q_scale: float,
     q_max: float,
     node_idx_bits: int,
 ):
-    t = pl.program_id(0)
+    tn = pl.program_id(0)
     big = jnp.int32(_I32MAX)
     rank_inf = jnp.float32(RANK_INF)
     d = d_ref[:]
@@ -69,17 +88,13 @@ def _bid_kernel(
     gf = gf_ref[:]
     mf = mf_ref[:]
 
-    feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, J]
+    feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, TILE_J]
     # Per-node priority fence: bid only if no higher-priority unplaced job
-    # finds this node feasible. RANK_INF rows drop out of the min and the
-    # <= check both.
-    minrank = jnp.min(
-        jnp.where(feas, rankf, rank_inf), axis=1, keepdims=True
-    )  # [TILE_N, 1]
-    allowed = feas & (rankf <= minrank) & (rankf < rank_inf * 0.5)
+    # finds this node feasible anywhere in [0, J). RANK_INF rows drop out.
+    allowed = feas & (rankf <= minrank_ref[:]) & (rankf < rank_inf * 0.5)
 
     q = jnp.clip((s_ref[:] + u_ref[:] - q_lo) * q_scale, 0.0, q_max)
-    n_glob = t * TILE_N + jax.lax.broadcasted_iota(
+    n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
         jnp.int32, feas.shape, 0
     )
     packed = jnp.where(
@@ -103,6 +118,7 @@ def bid_reduce_pallas(
     d: jax.Array,  # [J]
     md: jax.Array,  # [J]
     rankf_eff: jax.Array,  # [J] (RANK_INF when may-not-bid)
+    minrank: jax.Array,  # [N] fence minimum over all jobs
     *,
     q_lo: float,
     q_scale: float,
@@ -122,7 +138,9 @@ def bid_reduce_pallas(
             f"pallas round kernels need 128-aligned axes, got N={N} J={J}; "
             "use accel='jnp' for unaligned bucket shapes"
         )
-    tiles = N // TILE_N
+    tiles_n = N // TILE_N
+    tile_j = _tile_j(J)
+    tiles_j = J // tile_j
     kern = functools.partial(
         _bid_kernel,
         q_lo=q_lo,
@@ -130,11 +148,18 @@ def bid_reduce_pallas(
         q_max=q_max,
         node_idx_bits=node_idx_bits,
     )
-    row = pl.BlockSpec((1, J), lambda t: (0, 0), memory_space=pltpu.VMEM)
-    col = pl.BlockSpec((TILE_N, 1), lambda t: (t, 0), memory_space=pltpu.VMEM)
+    # grid (tn, tj): every (tn, tj) writes a disjoint output block, so
+    # grid order is free; tj innermost keeps S reads sequential per node
+    # tile.
+    row = pl.BlockSpec(
+        (1, tile_j), lambda tn, tj: (0, tj), memory_space=pltpu.VMEM
+    )
+    col = pl.BlockSpec(
+        (TILE_N, 1), lambda tn, tj: (tn, 0), memory_space=pltpu.VMEM
+    )
     per_group = pl.pallas_call(
         kern,
-        grid=(tiles,),
+        grid=(tiles_n, tiles_j),
         in_specs=[
             row,  # d
             row,  # md
@@ -142,12 +167,16 @@ def bid_reduce_pallas(
             col,  # gf
             col,  # mf
             col,  # u
+            col,  # minrank
             pl.BlockSpec(
-                (TILE_N, J), lambda t: (t, 0), memory_space=pltpu.VMEM
+                (TILE_N, tile_j), lambda tn, tj: (tn, tj),
+                memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec((8, J), lambda t: (t, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((8 * tiles, J), jnp.int32),
+        out_specs=pl.BlockSpec(
+            (8, tile_j), lambda tn, tj: (tn, tj), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((8 * tiles_n, J), jnp.int32),
         interpret=interpret,
     )(
         d.reshape(1, J),
@@ -156,11 +185,12 @@ def bid_reduce_pallas(
         gf_eff.reshape(N, 1),
         mf.reshape(N, 1),
         u.reshape(N, 1),
+        minrank.reshape(N, 1),
         s_t,
     )
     prim = jnp.min(per_group, axis=0)  # [J]
     prim_group = jnp.argmin(per_group, axis=0)
-    g_iota = jnp.arange(8 * tiles, dtype=jnp.int32)
+    g_iota = jnp.arange(8 * tiles_n, dtype=jnp.int32)
     alt = jnp.min(
         jnp.where(
             g_iota[:, None] == prim_group[None, :],
@@ -173,25 +203,41 @@ def bid_reduce_pallas(
 
 
 def _accept_kernel(
-    ch_ref,  # [1, J] i32 chosen node (N = no bid)
-    key_ref,  # [1, J] i32 accept key
-    d_ref,  # [1, J] f32
-    md_ref,  # [1, J] f32
+    ch_ref,  # [1, TILE_J] i32 chosen node (N = no bid)
+    key_ref,  # [1, TILE_J] i32 accept key
+    d_ref,  # [1, TILE_J] f32
+    md_ref,  # [1, TILE_J] f32
     tg_ref,  # [TILE_N, 1] f32 out: bidder gpu total
     tm_ref,  # [TILE_N, 1] f32 out: bidder mem total
     win_ref,  # [TILE_N, 1] i32 out: winning key
 ):
-    t = pl.program_id(0)
+    tn = pl.program_id(0)
+    tj = pl.program_id(1)
     big = jnp.int32(_I32MAX)
     ch = ch_ref[:]
     key = key_ref[:]
-    n_glob = t * TILE_N + jax.lax.broadcasted_iota(
+    n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
         jnp.int32, (TILE_N, ch.shape[1]), 0
     )
-    mine = ch == n_glob  # [TILE_N, J]; the N sentinel matches no node
-    tg_ref[:] = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
-    tm_ref[:] = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
-    win_ref[:] = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
+    mine = ch == n_glob  # [TILE_N, TILE_J]; the N sentinel matches no node
+    tg = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
+    tm = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
+    win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
+
+    # tj is the innermost grid dim: initialize at the first J tile, then
+    # accumulate — the output block index is tj-independent, so Mosaic
+    # keeps it resident in VMEM across the J sweep.
+    @pl.when(tj == 0)
+    def _init():
+        tg_ref[:] = tg
+        tm_ref[:] = tm
+        win_ref[:] = win
+
+    @pl.when(tj != 0)
+    def _accum():
+        tg_ref[:] = tg_ref[:] + tg
+        tm_ref[:] = tm_ref[:] + tm
+        win_ref[:] = jnp.minimum(win_ref[:], win)
 
 
 def accept_reduce_pallas(
@@ -210,14 +256,18 @@ def accept_reduce_pallas(
             f"pallas round kernels need 128-aligned axes, got N={num_nodes} "
             f"J={J}; use accel='jnp' for unaligned bucket shapes"
         )
-    tiles = num_nodes // TILE_N
-    row = pl.BlockSpec((1, J), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    tiles_n = num_nodes // TILE_N
+    tile_j = _tile_j(J)
+    tiles_j = J // tile_j
+    row = pl.BlockSpec(
+        (1, tile_j), lambda tn, tj: (0, tj), memory_space=pltpu.VMEM
+    )
     col_out = pl.BlockSpec(
-        (TILE_N, 1), lambda t: (t, 0), memory_space=pltpu.VMEM
+        (TILE_N, 1), lambda tn, tj: (tn, 0), memory_space=pltpu.VMEM
     )
     tg, tm, win = pl.pallas_call(
         _accept_kernel,
-        grid=(tiles,),
+        grid=(tiles_n, tiles_j),
         in_specs=[row, row, row, row],
         out_specs=[col_out, col_out, col_out],
         out_shape=[

@@ -94,12 +94,17 @@ class NodeSet:
 
 @dataclass
 class Problem:
-    """One tick's scheduling problem, fully on device."""
+    """One tick's scheduling problem, fully on device.
+
+    Deliberately carries NO true (unpadded) counts: the ``valid`` masks
+    are the on-device truth, and host-side callers track their own true
+    sizes (e.g. ``SolveRequest.num_jobs``). An earlier revision kept true
+    counts here as pytree metadata — which keyed the jit cache, so every
+    distinct job count recompiled the solver and defeated bucketing.
+    """
 
     jobs: JobSet
     nodes: NodeSet
-    num_jobs: int  # true (unpadded) counts — static per bucket use
-    num_nodes: int
 
 
 jax.tree_util.register_dataclass(
@@ -117,7 +122,7 @@ jax.tree_util.register_dataclass(
 jax.tree_util.register_dataclass(
     Problem,
     data_fields=["jobs", "nodes"],
-    meta_fields=["num_jobs", "num_nodes"],
+    meta_fields=[],
 )
 
 
@@ -157,6 +162,104 @@ def _densify_gangs(gang: np.ndarray) -> np.ndarray:
     return out
 
 
+def _prep_padded_arrays(
+    *,
+    job_gpu: np.ndarray,
+    job_mem_gib: np.ndarray,
+    job_priority: np.ndarray | None = None,
+    job_gang: np.ndarray | None = None,
+    job_model: np.ndarray | None = None,
+    job_current_node: np.ndarray | None = None,
+    node_gpu_free: np.ndarray,
+    node_mem_free_gib: np.ndarray,
+    node_gpu_capacity: np.ndarray | None = None,
+    node_mem_capacity_gib: np.ndarray | None = None,
+    node_topology: np.ndarray | None = None,
+    node_cached: np.ndarray | None = None,
+    job_multiple: int = 1,
+    node_multiple: int = 1,
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int, int, int, int]:
+    """Shared host-side prep: bucket, pad, densify. Returns numpy fields
+    (jobs dict, nodes dict) + (J_true, N_true, J, N)."""
+    J_true = int(job_gpu.shape[0])
+    N_true = int(node_gpu_free.shape[0])
+    J = bucket_size(max(J_true, 1))
+    N = bucket_size(max(N_true, 1))
+    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
+    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+
+    def padj(a, fill, dtype):
+        out = np.full(J, fill, dtype)
+        out[:J_true] = a
+        return out
+
+    def padn(a, fill, dtype):
+        out = np.full(N, fill, dtype)
+        out[:N_true] = a
+        return out
+
+    cached = np.zeros((N, MAX_MODELS), bool)
+    if node_cached is not None:
+        cached[:N_true, : node_cached.shape[1]] = node_cached
+    jvalid = np.zeros(J, bool)
+    jvalid[:J_true] = True
+    nvalid = np.zeros(N, bool)
+    nvalid[:N_true] = True
+
+    zeros_j = np.zeros(J_true, np.float32)
+    jobs = {
+        "gpu_demand": padj(job_gpu, 0, np.float32),
+        "mem_demand": padj(job_mem_gib, 0, np.float32),
+        "priority": padj(
+            job_priority if job_priority is not None else zeros_j,
+            0, np.float32,
+        ),
+        "gang_id": padj(
+            _densify_gangs(np.asarray(job_gang, np.int32))
+            if job_gang is not None
+            else np.full(J_true, -1),
+            -1, np.int32,
+        ),
+        "model_id": padj(
+            # Out-of-table slots collapse to 0 ("no affinity") rather than
+            # letting jnp.take's clip manufacture false cache hits for
+            # whichever model owns slot MAX_MODELS-1.
+            np.where((job_model >= 0) & (job_model < MAX_MODELS), job_model, 0)
+            if job_model is not None
+            else np.zeros(J_true),
+            0, np.int32,
+        ),
+        "current_node": padj(
+            job_current_node
+            if job_current_node is not None
+            else np.full(J_true, -1),
+            -1, np.int32,
+        ),
+        "valid": jvalid,
+    }
+    nodes = {
+        "gpu_free": padn(node_gpu_free, 0, np.float32),
+        "mem_free": padn(node_mem_free_gib, 0, np.float32),
+        "gpu_capacity": padn(
+            node_gpu_capacity if node_gpu_capacity is not None else node_gpu_free,
+            0, np.float32,
+        ),
+        "mem_capacity": padn(
+            node_mem_capacity_gib
+            if node_mem_capacity_gib is not None
+            else node_mem_free_gib,
+            0, np.float32,
+        ),
+        "topology": padn(
+            node_topology if node_topology is not None else np.zeros(N_true),
+            0, np.int32,
+        ),
+        "cached": cached,
+        "valid": nvalid,
+    }
+    return jobs, nodes, J_true, N_true, J, N
+
+
 def encode_problem_arrays(
     *,
     job_gpu: np.ndarray,
@@ -183,89 +286,106 @@ def encode_problem_arrays(
     of a mesh axis size, so shards stay equal-sized when the problem is
     placed on a device mesh whose axis does not divide the bucket (buckets
     are all multiples of 64, so powers of two <= 64 never need this)."""
-    J_true = int(job_gpu.shape[0])
-    N_true = int(node_gpu_free.shape[0])
-    J = bucket_size(max(J_true, 1))
-    N = bucket_size(max(N_true, 1))
-    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
-    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+    jobs, nodes, J_true, N_true, _, _ = _prep_padded_arrays(
+        job_gpu=job_gpu, job_mem_gib=job_mem_gib, job_priority=job_priority,
+        job_gang=job_gang, job_model=job_model,
+        job_current_node=job_current_node,
+        node_gpu_free=node_gpu_free, node_mem_free_gib=node_mem_free_gib,
+        node_gpu_capacity=node_gpu_capacity,
+        node_mem_capacity_gib=node_mem_capacity_gib,
+        node_topology=node_topology, node_cached=node_cached,
+        job_multiple=job_multiple, node_multiple=node_multiple,
+    )
+    return Problem(
+        jobs=JobSet(**{k: jnp.asarray(v) for k, v in jobs.items()}),
+        nodes=NodeSet(**{k: jnp.asarray(v) for k, v in nodes.items()}),
+    )
 
-    def padj(a, fill, dtype):
-        out = np.full(J, fill, dtype)
-        out[:J_true] = a
-        return jnp.asarray(out)
 
-    def padn(a, fill, dtype):
-        out = np.full(N, fill, dtype)
-        out[:N_true] = a
-        return jnp.asarray(out)
+# --- single-buffer packing (one host->device transfer per solve) -----------
+#
+# Under a remote PJRT attachment every device_put pays per-transfer
+# overhead; 14 field transfers per solve cost more than the solve. The
+# packed path lays the whole problem into ONE contiguous f32 buffer
+# (i32/bool regions bitcast — no value conversion) and unpacks with free
+# slices/bitcasts inside the jitted solve.
+#
+# Layout, in 4-byte words (J/N are the padded bucket sizes):
+#   [0,   3J) job f32 fields: gpu_demand, mem_demand, priority
+#   [3J,  7J) job i32 fields: gang_id, model_id, current_node, valid
+#   [7J, 7J+4N) node f32 fields: gpu_free, mem_free, gpu_capacity,
+#               mem_capacity
+#   [7J+4N, 7J+6N) node i32 fields: topology, valid
+#   [7J+6N, 7J+6N+N*MAX_MODELS/4) cached bitmap, uint8[N, MAX_MODELS]
 
-    cached = np.zeros((N, MAX_MODELS), bool)
-    if node_cached is not None:
-        cached[:N_true, : node_cached.shape[1]] = node_cached
-    jvalid = np.zeros(J, bool)
-    jvalid[:J_true] = True
-    nvalid = np.zeros(N, bool)
-    nvalid[:N_true] = True
+_CACHED_WORDS = MAX_MODELS // 4  # f32 words per node of cached bitmap
 
-    zeros_j = np.zeros(J_true, np.float32)
+
+def packed_words(J: int, N: int) -> int:
+    return 7 * J + 6 * N + N * _CACHED_WORDS
+
+
+def pack_problem_arrays(**kwargs) -> tuple[np.ndarray, int, int, int, int]:
+    """Host-side packing; same kwargs as ``encode_problem_arrays``.
+
+    Returns ``(buf f32[packed_words], J_true, N_true, J, N)``.
+    """
+    jobs, nodes, J_true, N_true, J, N = _prep_padded_arrays(**kwargs)
+    buf = np.empty(packed_words(J, N), np.float32)
+    i32 = buf.view(np.int32)
+    o = 0
+    for k in ("gpu_demand", "mem_demand", "priority"):
+        buf[o : o + J] = jobs[k]
+        o += J
+    for k in ("gang_id", "model_id", "current_node"):
+        i32[o : o + J] = jobs[k]
+        o += J
+    i32[o : o + J] = jobs["valid"]
+    o += J
+    for k in ("gpu_free", "mem_free", "gpu_capacity", "mem_capacity"):
+        buf[o : o + N] = nodes[k]
+        o += N
+    i32[o : o + N] = nodes["topology"]
+    o += N
+    i32[o : o + N] = nodes["valid"]
+    o += N
+    buf[o:].view(np.uint8)[:] = nodes["cached"].reshape(-1)
+    return buf, J_true, N_true, J, N
+
+
+def unpack_problem(buf: jax.Array, J: int, N: int) -> Problem:
+    """Jittable inverse of ``pack_problem_arrays`` (slices + bitcasts only;
+    XLA fuses these into the consumers, so unpacking is effectively free).
+    """
+    from jax import lax
+
+    def f32(o, n):
+        return lax.slice(buf, (o,), (o + n,))
+
+    def i32(o, n):
+        return lax.bitcast_convert_type(f32(o, n), jnp.int32)
+
+    gpu_d, mem_d, prio = f32(0, J), f32(J, J), f32(2 * J, J)
+    gang, model, cur = i32(3 * J, J), i32(4 * J, J), i32(5 * J, J)
+    jvalid = i32(6 * J, J) != 0
+    o = 7 * J
+    gpu_f, mem_f = f32(o, N), f32(o + N, N)
+    gpu_c, mem_c = f32(o + 2 * N, N), f32(o + 3 * N, N)
+    topo = i32(o + 4 * N, N)
+    nvalid = i32(o + 5 * N, N) != 0
+    cached = lax.bitcast_convert_type(
+        f32(o + 6 * N, N * _CACHED_WORDS).reshape(N, _CACHED_WORDS),
+        jnp.uint8,
+    ).reshape(N, MAX_MODELS) != 0
     return Problem(
         jobs=JobSet(
-            gpu_demand=padj(job_gpu, 0, np.float32),
-            mem_demand=padj(job_mem_gib, 0, np.float32),
-            priority=padj(
-                job_priority if job_priority is not None else zeros_j, 0, np.float32
-            ),
-            gang_id=padj(
-                _densify_gangs(np.asarray(job_gang, np.int32))
-                if job_gang is not None
-                else np.full(J_true, -1),
-                -1,
-                np.int32,
-            ),
-            model_id=padj(
-                # Out-of-table slots collapse to 0 ("no affinity") rather than
-                # letting jnp.take's clip manufacture false cache hits for
-                # whichever model owns slot MAX_MODELS-1.
-                np.where(
-                    (job_model >= 0) & (job_model < MAX_MODELS), job_model, 0
-                )
-                if job_model is not None
-                else np.zeros(J_true),
-                0,
-                np.int32,
-            ),
-            current_node=padj(
-                job_current_node if job_current_node is not None else np.full(J_true, -1),
-                -1,
-                np.int32,
-            ),
-            valid=jnp.asarray(jvalid),
+            gpu_demand=gpu_d, mem_demand=mem_d, priority=prio,
+            gang_id=gang, model_id=model, current_node=cur, valid=jvalid,
         ),
         nodes=NodeSet(
-            gpu_free=padn(node_gpu_free, 0, np.float32),
-            mem_free=padn(node_mem_free_gib, 0, np.float32),
-            gpu_capacity=padn(
-                node_gpu_capacity if node_gpu_capacity is not None else node_gpu_free,
-                0,
-                np.float32,
-            ),
-            mem_capacity=padn(
-                node_mem_capacity_gib
-                if node_mem_capacity_gib is not None
-                else node_mem_free_gib,
-                0,
-                np.float32,
-            ),
-            topology=padn(
-                node_topology if node_topology is not None else np.zeros(N_true), 0,
-                np.int32,
-            ),
-            cached=jnp.asarray(cached),
-            valid=jnp.asarray(nvalid),
+            gpu_free=gpu_f, mem_free=mem_f, gpu_capacity=gpu_c,
+            mem_capacity=mem_c, topology=topo, cached=cached, valid=nvalid,
         ),
-        num_jobs=J_true,
-        num_nodes=N_true,
     )
 
 
@@ -348,7 +468,5 @@ def encode_problem(
             cached=jnp.asarray(cached),
             valid=jnp.asarray(nvalid),
         ),
-        num_jobs=len(jobs),
-        num_nodes=len(nodes),
     )
     return problem, model_table

@@ -100,7 +100,7 @@ def shard_problem(p: Problem, mesh: Mesh) -> Problem:
         cached=put(p.nodes.cached, NamedSharding(mesh, P("nodes", None))),
         valid=put(p.nodes.valid, ns),
     )
-    return Problem(jobs=jobs, nodes=nodes, num_jobs=p.num_jobs, num_nodes=p.num_nodes)
+    return Problem(jobs=jobs, nodes=nodes)
 
 
 def solve_sharded(

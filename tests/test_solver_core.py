@@ -103,6 +103,23 @@ class TestGreedySmall:
         a = solve_greedy(p, ScoreWeights(noise=0.0))
         assert int(a.node[0]) == 1
 
+    def test_large_job_not_stranded_by_small_bidders(self):
+        # FFD accept order: a contested node must go to its LARGEST bidder.
+        # With ascending order the 8-chip job loses every whole-idle node to
+        # trivially-relocatable small jobs and ends unplaced even though a
+        # serial FFD places all four (regression: pre-fix this placed 3/4).
+        jobs = [
+            JobRow(gpu=2, mem_gib=8),
+            JobRow(gpu=4, mem_gib=16),
+            JobRow(gpu=1, mem_gib=4),
+            JobRow(gpu=8, mem_gib=32),
+        ]
+        nodes = [NodeRow(gpu_free=8, mem_free_gib=64) for _ in range(2)]
+        p, _ = encode_problem(jobs, nodes)
+        a = solve_greedy(p)
+        assert int(a.placed) == 4
+        assert_invariants(p, jobs, nodes, a)
+
     def test_infeasible_unplaced(self):
         jobs = [JobRow(gpu=16, mem_gib=10)]
         nodes = [NodeRow(gpu_free=8, mem_free_gib=100)]
@@ -381,6 +398,35 @@ class TestPallasParity:
             node_mem_free_gib=np.full(N, 128.0, np.float32),
             node_cached=(rng.random((N, 16)) < 0.1),
         )
+        ref = solve_greedy(p, accel="jnp")
+        pal = solve_greedy(p, accel="interpret")
+        assert np.array_equal(np.asarray(ref.node), np.asarray(pal.node))
+        assert int(ref.placed) == int(pal.placed)
+
+    def test_interpret_matches_jnp_j_tiled(self, monkeypatch):
+        """J-axis tiling (tiles_j > 1): the bid kernel's 2-D grid and the
+        accept kernel's init-at-tj0/accumulate-across-tj logic must be
+        bit-identical to the untiled jnp reference. MAX_TILE_J is patched
+        small so the multi-tile path runs at test-sized shapes (in
+        production it only engages at J > 4096 on real TPUs)."""
+        import numpy as np
+        from kubeinfer_tpu.solver import pallas_kernels as pk
+        from kubeinfer_tpu.solver.core import solve_greedy
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        monkeypatch.setattr(pk, "MAX_TILE_J", 128)
+        rng = np.random.default_rng(9)
+        J, N = 384, 128  # 3 J tiles of 128
+        p = encode_problem_arrays(
+            job_gpu=rng.integers(1, 8, J).astype(np.float32),
+            job_mem_gib=rng.integers(4, 64, J).astype(np.float32),
+            job_priority=rng.integers(0, 4, J).astype(np.float32),
+            job_model=rng.integers(0, 16, J).astype(np.int32),
+            node_gpu_free=np.full(N, 16.0, np.float32),
+            node_mem_free_gib=np.full(N, 128.0, np.float32),
+            node_cached=(rng.random((N, 16)) < 0.1),
+        )
+        assert pk._tile_j(J) == 128  # multi-tile path engaged
         ref = solve_greedy(p, accel="jnp")
         pal = solve_greedy(p, accel="interpret")
         assert np.array_equal(np.asarray(ref.node), np.asarray(pal.node))
