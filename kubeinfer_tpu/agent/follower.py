@@ -48,15 +48,28 @@ class Follower:
         return self._ready.wait(timeout)
 
     def sync(self) -> None:
-        """Pull model files from the coordinator (follower.go:52-63)."""
-        if ensure_model_dir(self.model_path):
-            log.info("model cache hit at %s", self.model_path)
-            return
+        """Pull model files from the coordinator (follower.go:52-63).
+
+        Always runs sync_model — even over a warm cache — because a
+        checksum pass is the only thing that catches same-size stale
+        content after a coordinator failover (sync skips files whose
+        checksums match, so the warm-cache case costs one listing plus
+        local hashing, no transfers). The download histogram only records
+        syncs that actually moved bytes, keeping the WAN-vs-cluster
+        comparison (PROJECT_ROADMAP.md:62) honest.
+        """
+        warm = ensure_model_dir(self.model_path)
+        if warm:
+            log.info(
+                "model cache present at %s; verifying against coordinator",
+                self.model_path,
+            )
         t0 = time.perf_counter()
         sync_model(self._endpoint, self.model_path, attempts=self._sync_attempts)
-        metrics.model_download_duration_seconds.observe(
-            "coordinator", time.perf_counter() - t0
-        )
+        if not warm:
+            metrics.model_download_duration_seconds.observe(
+                "coordinator", time.perf_counter() - t0
+            )
 
     def start_serving(self) -> None:
         """Start the runtime once the model is in place."""

@@ -12,15 +12,63 @@ Fixes over the reference (both SURVEY.md-documented gaps):
 - **Range requests** are honored (bytes=start-), enabling the resumable
   follower downloads the reference roadmap left as a TODO
   (PROJECT_ROADMAP.md:88-90).
+- The listing carries **size + sha256** per file
+  (``<relpath>\\t<size>\\t<sha256>`` lines), so followers detect
+  same-size content drift — e.g. a file that changed across a
+  coordinator failover — instead of trusting sizes alone
+  (PROJECT_ROADMAP.md:88-90's integrity TODO). Checksums are cached by
+  (size, mtime); files above ``INLINE_HASH_MAX`` are hashed by a
+  background warmer rather than inside the request handler (a multi-GB
+  weights dir hashed inline would stall /models past the follower's
+  socket timeout), and until warmed their sha field is empty — clients
+  treat an empty sha as "no verification available yet".
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.server
 import os
 import pathlib
 import threading
 import urllib.parse
+
+# (abs path, size, mtime_ns) -> sha256 hex; shared across handler threads.
+# Plain dict: CPython dict ops are atomic enough for a cache (worst case
+# two threads hash the same file once each).
+_CHECKSUM_CACHE: dict[tuple[str, int, int], str] = {}
+
+# Files up to this size are hashed inline in the listing handler (64 MiB
+# ~ tens of ms); larger ones only by the background warmer.
+INLINE_HASH_MAX = 64 << 20
+
+
+def file_sha256(path: pathlib.Path) -> str:
+    st = path.stat()
+    key = (str(path), st.st_size, st.st_mtime_ns)
+    cached = _CHECKSUM_CACHE.get(key)
+    if cached is None:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        cached = h.hexdigest()
+        _CHECKSUM_CACHE[key] = cached
+    return cached
+
+
+def cached_sha256(path: pathlib.Path, inline_max: int = INLINE_HASH_MAX) -> str:
+    """sha256 if cheap ("" otherwise): cached, or small enough to hash now."""
+    try:
+        st = path.stat()
+    except OSError:
+        return ""
+    hit = _CHECKSUM_CACHE.get((str(path), st.st_size, st.st_mtime_ns))
+    if hit is not None:
+        return hit
+    if st.st_size <= inline_max:
+        return file_sha256(path)
+    return ""
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -52,13 +100,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _list_models(self) -> None:
-        """Newline-separated relative paths, recursive."""
-        files = sorted(
-            str(p.relative_to(self.root))
-            for p in self.root.rglob("*")
-            if p.is_file()
-        )
-        self._send_text("\n".join(files) + ("\n" if files else ""))
+        """Newline-separated ``relpath\\tsize\\tsha256`` lines, recursive.
+
+        The sha field is empty for large files the background warmer
+        hasn't reached — hashing them here would stall the listing past
+        client socket timeouts.
+        """
+        entries = []
+        for p in sorted(self.root.rglob("*")):
+            if not p.is_file() or p.name.endswith(".part"):
+                continue
+            rel = str(p.relative_to(self.root))
+            entries.append(f"{rel}\t{p.stat().st_size}\t{cached_sha256(p)}")
+        self._send_text("\n".join(entries) + ("\n" if entries else ""))
 
     def _resolve(self, rel: str) -> pathlib.Path | None:
         """Path traversal guard (model_server.go:88-100)."""
@@ -130,6 +184,21 @@ class ModelServer:
         )
         self._thread = t
         t.start()
+        # Pre-hash big files off the request path so listings gain their
+        # checksums shortly after startup without ever blocking a client.
+        warmer = threading.Thread(
+            target=self._warm_checksums, daemon=True,
+            name=f"checksum-warmer-{self.port}",
+        )
+        warmer.start()
+
+    def _warm_checksums(self) -> None:
+        try:
+            for p in sorted(self._root.rglob("*")):
+                if p.is_file() and not p.name.endswith(".part"):
+                    file_sha256(p)
+        except OSError:
+            pass  # dir vanished mid-walk; next listing reflects reality
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -11,21 +11,56 @@ paths, no retry, no resume. Both the nesting and resume gaps are fixed here:
 - per-file retry with bounded attempts (coordinator may be mid-failover);
 - completed sizes are validated against the server's Content-Length /
   Content-Range total, so a stale partial resumed against a changed file is
-  rejected instead of silently appended. (Same-size content drift is not
-  detected — the listing protocol carries no checksums yet.)
+  rejected instead of silently appended;
+- the listing carries per-file size + sha256 (model_server.py), and every
+  completed download — including already-present files — is verified
+  against it, so same-size content drift (a file changed across a
+  coordinator failover) is detected and re-fetched instead of served.
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import os
 import pathlib
 import time
 import urllib.parse
+from dataclasses import dataclass
 
 
 class TransferError(RuntimeError):
     pass
+
+
+@dataclass(frozen=True)
+class FileEntry:
+    """One line of the coordinator's /models listing."""
+
+    path: str
+    size: int = -1  # -1 = listing carried no metadata
+    sha256: str = ""
+
+    @classmethod
+    def parse(cls, line: str) -> "FileEntry":
+        parts = line.split("\t")
+        if len(parts) >= 3:
+            try:
+                return cls(parts[0], int(parts[1]), parts[2])
+            except ValueError:
+                # malformed metadata (e.g. a tab inside a filename):
+                # degrade to an unverified bare path rather than crashing
+                # the sync with a non-TransferError
+                return cls(line)
+        return cls(parts[0])  # tolerate bare-path listings
+
+
+def _local_sha256(path: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _open(endpoint: str) -> tuple[http.client.HTTPConnection, str]:
@@ -35,8 +70,8 @@ def _open(endpoint: str) -> tuple[http.client.HTTPConnection, str]:
     return http.client.HTTPConnection(u.hostname, u.port, timeout=10), u.path.rstrip("/")
 
 
-def fetch_file_list(endpoint: str) -> list[str]:
-    """GET /models → relative paths (follower.go:83-110 parity)."""
+def fetch_file_list(endpoint: str) -> list[FileEntry]:
+    """GET /models → FileEntry list (follower.go:83-110 parity + metadata)."""
     conn, base = _open(endpoint)
     try:
         conn.request("GET", base + "/models")
@@ -46,7 +81,7 @@ def fetch_file_list(endpoint: str) -> list[str]:
         body = resp.read().decode()
     finally:
         conn.close()
-    return [line for line in body.splitlines() if line.strip()]
+    return [FileEntry.parse(line) for line in body.splitlines() if line.strip()]
 
 
 def download_file(
@@ -137,13 +172,27 @@ def sync_model(
             ep = resolve()
             if not ep:
                 raise TransferError("no coordinator endpoint available")
-            files = fetch_file_list(ep)
-            for rel in files:
-                dest = pathlib.Path(dest_dir) / rel
+            entries = fetch_file_list(ep)
+            for entry in entries:
+                dest = pathlib.Path(dest_dir) / entry.path
                 if dest.exists():
-                    continue  # already completed (rename is the marker)
-                download_file(ep, rel, dest_dir)
-            return files
+                    # rename is the completion marker, but the CONTENT may
+                    # still be stale (coordinator changed across failover,
+                    # possibly at the same size): trust only a checksum
+                    # match when the listing carries one.
+                    if not entry.sha256 or _local_sha256(dest) == entry.sha256:
+                        continue
+                    dest.unlink()
+                download_file(ep, entry.path, dest_dir)
+                if entry.sha256:
+                    got = _local_sha256(dest)
+                    if got != entry.sha256:
+                        dest.unlink(missing_ok=True)
+                        raise TransferError(
+                            f"{entry.path}: checksum mismatch after download "
+                            f"(got {got[:12]}…, want {entry.sha256[:12]}…)"
+                        )
+            return [e.path for e in entries]
         except (TransferError, OSError, http.client.HTTPException) as e:
             last = e
             if attempt < attempts - 1:

@@ -1,0 +1,145 @@
+"""Multi-host distributed runtime: ``jax.distributed`` init + global mesh.
+
+The reference's only inter-node channels are the K8s API server and plain
+HTTP (SURVEY.md §2 "Collective comm backend: absent"). The TPU-native
+equivalent (SURVEY.md §5) is XLA collectives over ICI within a slice and
+DCN across slices; this module owns the process-group bootstrap and the
+DCN-aware mesh construction the sharded solver (solver/sharded.py) runs
+on.
+
+Topology: the solver's ``jobs`` axis is the data-parallel axis, so it maps
+across hosts (DCN) — the per-round cross-shard traffic is a handful of [J]
+vectors (10k jobs ≈ 160KB), far below DCN bandwidth, while the [N, J] cost
+field never leaves a device. The ``nodes`` axis stays within a host (ICI)
+where its min-reductions are cheap. This is the "shard the big axis where
+the traffic is small" rule from the scaling-book recipe.
+
+Bootstrap env contract (set by the deployment layer; all optional — absent
+means single-process):
+
+  KUBEINFER_COORDINATOR   "host:port" of process 0 (jax.distributed
+                          coordinator service)
+  KUBEINFER_PROCESS_ID    this process's rank, 0-based
+  KUBEINFER_NUM_PROCESSES total process count
+  KUBEINFER_LOCAL_DEVICE_IDS  optional comma list restricting local devices
+
+``initialize()`` is idempotent and a no-op without the env, so every
+entrypoint can call it unconditionally (manager does at startup).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    coordinator_address: str
+    process_id: int
+    num_processes: int
+    local_device_ids: tuple[int, ...] | None = None
+
+
+def config_from_env(env=None) -> DistributedConfig | None:
+    """Parse the bootstrap env; None = single-process (no env set).
+
+    Raises ValueError when the env is partially set — a half-configured
+    fleet must fail loudly at startup, not deadlock in initialize().
+    """
+    env = os.environ if env is None else env
+    addr = env.get("KUBEINFER_COORDINATOR", "")
+    pid = env.get("KUBEINFER_PROCESS_ID", "")
+    nproc = env.get("KUBEINFER_NUM_PROCESSES", "")
+    if not addr and not pid and not nproc:
+        return None
+    if not (addr and pid and nproc):
+        raise ValueError(
+            "partial distributed env: KUBEINFER_COORDINATOR, "
+            "KUBEINFER_PROCESS_ID and KUBEINFER_NUM_PROCESSES must all be "
+            f"set (got coordinator={addr!r}, id={pid!r}, n={nproc!r})"
+        )
+    process_id = int(pid)
+    num_processes = int(nproc)
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"process id {process_id} outside [0, {num_processes})"
+        )
+    ids = env.get("KUBEINFER_LOCAL_DEVICE_IDS", "")
+    local = tuple(int(x) for x in ids.split(",") if x) if ids else None
+    return DistributedConfig(addr, process_id, num_processes, local)
+
+
+def initialize(cfg: DistributedConfig | None = None, env=None) -> bool:
+    """Join the jax.distributed process group (no-op single-process).
+
+    Returns True when running multi-process. Safe to call more than once.
+    """
+    global _initialized
+    if cfg is None:
+        cfg = config_from_env(env)
+    if cfg is None or cfg.num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+
+    import jax
+
+    kwargs = {}
+    if cfg.local_device_ids is not None:
+        kwargs["local_device_ids"] = list(cfg.local_device_ids)
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+        **kwargs,
+    )
+    _initialized = True
+    log.info(
+        "joined distributed runtime: process %d/%d via %s (%d global devices)",
+        cfg.process_id, cfg.num_processes, cfg.coordinator_address,
+        jax.device_count(),
+    )
+    return True
+
+
+def global_mesh(node_axis: int = 1):
+    """(jobs, nodes) mesh over ALL global devices, DCN-aware.
+
+    Single-host: delegates to solver.sharded.make_mesh (contiguous
+    devices). Multi-host: hosts stack along the ``jobs`` axis (each host
+    contributes its local devices as job-parallel shards), so cross-host
+    traffic is the small [J]-vector gathers and ICI keeps the node-axis
+    reductions. ``node_axis`` must divide the per-host device count —
+    a nodes shard spanning DCN would put the [N, J] field's reduction on
+    the slow path, which this constructor refuses to build.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubeinfer_tpu.solver.sharded import make_mesh
+
+    if jax.process_count() <= 1:
+        return make_mesh(node_axis=node_axis)
+
+    devices = jax.devices()
+    per_host = len(devices) // jax.process_count()
+    if node_axis > per_host or per_host % node_axis:
+        raise ValueError(
+            f"node_axis {node_axis} must divide the per-host device count "
+            f"{per_host}: a nodes shard must never span DCN"
+        )
+    # Order devices host-major so the jobs axis tiles hosts contiguously.
+    by_host: dict[int, list] = {}
+    for d in devices:
+        by_host.setdefault(d.process_index, []).append(d)
+    ordered = [d for pid in sorted(by_host) for d in by_host[pid]]
+    job_axis = len(ordered) // node_axis
+    dev_array = np.asarray(ordered).reshape(job_axis, node_axis)
+    return Mesh(dev_array, axis_names=("jobs", "nodes"))

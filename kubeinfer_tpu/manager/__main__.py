@@ -84,6 +84,13 @@ def main(argv: list[str] | None = None) -> int:
         namespace=args.namespace,
     )
 
+    # Join the multi-host runtime when the fleet env is present (no-op
+    # single-process): must happen before any jax usage so the solver's
+    # mesh spans all hosts. See kubeinfer_tpu/distributed.py topology.
+    from kubeinfer_tpu import distributed
+
+    distributed.initialize()
+
     stop = threading.Event()
 
     def on_signal(signum, frame):

@@ -13,6 +13,7 @@ from kubeinfer_tpu.metrics.registry import (
     Histogram,
     Registry,
     REGISTRY,
+    auction_fallback_total,
     coordinator_elections_total,
     llmservice_ready_replicas,
     llmservice_total,
@@ -30,6 +31,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "REGISTRY",
+    "auction_fallback_total",
     "coordinator_elections_total",
     "llmservice_ready_replicas",
     "llmservice_total",

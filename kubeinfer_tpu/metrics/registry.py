@@ -242,3 +242,9 @@ solve_problem_size = Gauge(
     "Last solve problem axes",
     labels=("policy", "axis"),  # axis: jobs | nodes
 )
+auction_fallback_total = Counter(
+    "kubeinfer_auction_fallback_total",
+    "jax-auction requests rerouted to jax-greedy because the problem is "
+    "not a one-replica-per-node instance (auction would silently "
+    "under-place)",
+)

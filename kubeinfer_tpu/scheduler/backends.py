@@ -14,13 +14,32 @@ selected by a new schedulerPolicy spec field").
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import logging
+import os
 import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from kubeinfer_tpu.api.types import SchedulerPolicy
+
+log = logging.getLogger(__name__)
+
+
+def _profile_ctx():
+    """Per-solve jax.profiler capture, enabled by KUBEINFER_PROFILE_DIR
+    (SURVEY.md §5: "add jax.profiler traces from day one"). Each solve
+    writes a TensorBoard-loadable trace under <dir>/plugins/profile/...;
+    off (the default) costs nothing.
+    """
+    profile_dir = os.environ.get("KUBEINFER_PROFILE_DIR", "")
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)
 
 
 @functools.cache
@@ -134,12 +153,40 @@ class NativeGreedyBackend(SchedulerBackend):
         return SolveResult(assignment, placed, ms, self.name)
 
 
+def auction_suitable(req: SolveRequest) -> bool:
+    """Is this a one-replica-per-node instance the auction solver is
+    built for (core.solve_auction's documented scope)?
+
+    Two disqualifiers, each of which silently under-places under auction:
+    - more jobs than nodes: auction places at most one job per node;
+    - node-sharing demands: a job asking for at most half a node's chips
+      could legally share the node — auction would still dedicate the
+      whole node to it.
+    """
+    if req.num_jobs > req.num_nodes:
+        return False
+    caps = (
+        req.node_gpu_capacity
+        if req.node_gpu_capacity is not None
+        else req.node_gpu_free
+    )
+    max_cap = float(np.max(caps)) if caps.size else 0.0
+    min_demand = float(np.min(req.job_gpu)) if req.job_gpu.size else 0.0
+    return min_demand * 2.0 > max_cap
+
+
 class JaxBackend(SchedulerBackend):
     """Batched solve on the live JAX backend (TPU when present).
 
     One instance per policy (greedy/auction). Encoding pads both axes to
     buckets so the jit cache stays small; ``warmup`` pre-compiles the
     bucket a deployment expects to hit.
+
+    ``jax-auction`` is guarded: the auction algorithm only handles
+    one-replica-per-node (whole-node-request) instances and ignores
+    priority (core.solve_auction docstring). A user-selected auction
+    policy on an unsuitable problem auto-falls back to ``jax-greedy``
+    with a warning and a metric rather than silently under-placing.
     """
 
     def __init__(self, policy: SchedulerPolicy):
@@ -151,18 +198,49 @@ class JaxBackend(SchedulerBackend):
     def warmup(
         self, num_jobs: int = 1024, num_nodes: int = 128
     ) -> None:
-        req = SolveRequest(
-            job_gpu=np.ones(num_jobs, np.float32),
-            job_mem_gib=np.ones(num_jobs, np.float32),
-            node_gpu_free=np.full(num_nodes, 8.0, np.float32),
-            node_mem_free_gib=np.full(num_nodes, 64.0, np.float32),
-        )
+        if self._policy is SchedulerPolicy.JAX_AUCTION:
+            # The warmup problem must be one auction actually accepts
+            # (whole-node requests, jobs <= nodes), or the fallback guard
+            # fires, the GREEDY kernel compiles instead, and the first
+            # production auction solve pays the jit compile in-tick.
+            num_jobs = min(num_jobs, num_nodes)
+            req = SolveRequest(
+                job_gpu=np.full(num_jobs, 8.0, np.float32),
+                job_mem_gib=np.full(num_jobs, 64.0, np.float32),
+                node_gpu_free=np.full(num_nodes, 8.0, np.float32),
+                node_mem_free_gib=np.full(num_nodes, 64.0, np.float32),
+            )
+        else:
+            req = SolveRequest(
+                job_gpu=np.ones(num_jobs, np.float32),
+                job_mem_gib=np.ones(num_jobs, np.float32),
+                node_gpu_free=np.full(num_nodes, 8.0, np.float32),
+                node_mem_free_gib=np.full(num_nodes, 64.0, np.float32),
+            )
         self.solve(req)
 
     def solve(self, req: SolveRequest) -> SolveResult:
         import jax
 
         from kubeinfer_tpu.solver.problem import pack_problem_arrays
+
+        policy = self._policy.value
+        fellback = False
+        if (
+            self._policy is SchedulerPolicy.JAX_AUCTION
+            and not auction_suitable(req)
+        ):
+            from kubeinfer_tpu import metrics
+
+            metrics.auction_fallback_total.inc()
+            log.warning(
+                "jax-auction requested for a non-whole-node problem "
+                "(%d jobs, %d nodes): falling back to jax-greedy to avoid "
+                "under-placement",
+                req.num_jobs, req.num_nodes,
+            )
+            policy = SchedulerPolicy.JAX_GREEDY.value
+            fellback = True
 
         t0 = time.perf_counter()
         # Single-buffer packing: the whole problem ships in ONE transfer
@@ -184,26 +262,30 @@ class JaxBackend(SchedulerBackend):
             node_cached=req.node_cached,
         )
         t_encode = time.perf_counter()
-        out = _packed_solver()(
-            buf, J=J, N=N, policy=self._policy.value, accel="auto"
-        )
-        # ONE host readback for everything the caller needs: each extra
-        # sync (a separate np.asarray/int() call) is a full host<->device
-        # round trip, which under a remote PJRT relay costs ~65-100ms.
-        node_host, rounds_host = jax.device_get((out.node, out.rounds))
+        with _profile_ctx():
+            out = _packed_solver()(buf, J=J, N=N, policy=policy, accel="auto")
+            # ONE host readback for everything the caller needs: each extra
+            # sync (a separate np.asarray/int() call) is a full host<->device
+            # round trip, which under a remote PJRT relay costs ~65-100ms.
+            # Inside the profile context: dispatch is async, so the trace
+            # must stay open until this sync or device activity is lost.
+            node_host, rounds_host = jax.device_get((out.node, out.rounds))
         assignment = np.asarray(node_host[: req.num_jobs], np.int32)
         # Padded job rows can't place (valid=False) and padded node columns
         # can't be chosen (valid=False), so clipping to the true axes is
         # lossless; count placed on the clipped view.
         placed = int((assignment >= 0).sum())
         t1 = time.perf_counter()
+        extras = {"encode_ms": (t_encode - t0) * 1e3}
+        if fellback:
+            extras["auction_fallback"] = 1.0
         return SolveResult(
             assignment,
             placed,
             (t1 - t0) * 1e3,
-            self.name,
+            policy,  # the policy that actually solved (fallback-aware)
             rounds=int(rounds_host),
-            extras={"encode_ms": (t_encode - t0) * 1e3},
+            extras=extras,
         )
 
 

@@ -53,6 +53,11 @@ def make_mesh(
         n_devices = len(devices)
     if n_devices > len(devices):
         raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+    if node_axis < 1 or n_devices % node_axis:
+        raise ValueError(
+            f"node_axis {node_axis} must divide the device count "
+            f"{n_devices} (have {len(devices)} devices total)"
+        )
     if job_axis is None:
         job_axis = n_devices // node_axis
     if job_axis * node_axis != n_devices:

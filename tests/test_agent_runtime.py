@@ -6,6 +6,7 @@ resumable transfer client with mid-transfer coordinator-death fault
 injection (a test the reference roadmap wished for but never had).
 """
 
+import hashlib
 import http.client
 import pathlib
 import sys
@@ -128,12 +129,16 @@ class TestModelServer:
         with urllib.request.urlopen(server.endpoint + "/health") as r:
             assert r.read() == b"OK"  # model_server.go:39-49
 
-    def test_recursive_listing(self, served):
+    def test_recursive_listing_with_checksums(self, served):
         server, _ = served
-        files = fetch_file_list(server.endpoint)
+        entries = fetch_file_list(server.endpoint)
+        by_path = {e.path: e for e in entries}
         # nested path present (reference listed top level only)
-        assert "tokenizer/vocab.json" in files
-        assert "config.json" in files
+        assert "tokenizer/vocab.json" in by_path
+        assert "config.json" in by_path
+        cfg = by_path["config.json"]
+        assert cfg.size == len(b'{"arch": "test"}')
+        assert cfg.sha256 == hashlib.sha256(b'{"arch": "test"}').hexdigest()
 
     def test_download_nested_file(self, served, tmp_path):
         server, _ = served
@@ -213,6 +218,52 @@ class TestSyncModel:
             assert (dest / "model-00001.safetensors").read_bytes() == full
         finally:
             server2.stop()
+
+    def test_same_size_drift_detected_across_failover(self, tmp_path):
+        """A file that CHANGED CONTENT at the same size across a
+        coordinator failover must be re-fetched, not trusted — size-only
+        validation cannot see this (the r1 transfer layer's admitted gap).
+        """
+        src = tmp_path / "src"
+        src.mkdir()
+        make_model_dir(src)
+        dest = tmp_path / "dest"
+
+        server1 = ModelServer(str(src), port=0)
+        server1.start()
+        try:
+            sync_model(server1.endpoint, str(dest))
+        finally:
+            server1.stop()
+
+        # failover: new coordinator serves same-size different bytes
+        (src / "config.json").write_bytes(b'{"arch": "live"}')
+        assert (src / "config.json").stat().st_size == len(b'{"arch": "test"}')
+        server2 = ModelServer(str(src), port=0)
+        server2.start()
+        try:
+            sync_model(server2.endpoint, str(dest))
+            assert (dest / "config.json").read_bytes() == b'{"arch": "live"}'
+        finally:
+            server2.stop()
+
+    def test_corrupt_local_file_refetched(self, tmp_path):
+        """Local same-size corruption (disk fault, truncated-then-padded
+        write) is healed by the checksum pass."""
+        src = tmp_path / "src"
+        src.mkdir()
+        make_model_dir(src)
+        dest = tmp_path / "dest"
+        server = ModelServer(str(src), port=0)
+        server.start()
+        try:
+            sync_model(server.endpoint, str(dest))
+            good = (dest / "tokenizer" / "vocab.json").read_bytes()
+            (dest / "tokenizer" / "vocab.json").write_bytes(b"X" * len(good))
+            sync_model(server.endpoint, str(dest))
+            assert (dest / "tokenizer" / "vocab.json").read_bytes() == good
+        finally:
+            server.stop()
 
     def test_sync_fails_after_attempts_exhausted(self, tmp_path):
         with pytest.raises(TransferError):

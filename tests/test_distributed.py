@@ -1,0 +1,73 @@
+"""Distributed bootstrap tests (single-process; the multi-process path is
+exercised by construction logic, not a real fleet — CI has one host)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from kubeinfer_tpu import distributed
+from kubeinfer_tpu.distributed import DistributedConfig, config_from_env
+
+
+class TestConfigFromEnv:
+    def test_absent_env_is_single_process(self):
+        assert config_from_env({}) is None
+
+    def test_full_env_parses(self):
+        cfg = config_from_env({
+            "KUBEINFER_COORDINATOR": "10.0.0.1:8476",
+            "KUBEINFER_PROCESS_ID": "2",
+            "KUBEINFER_NUM_PROCESSES": "4",
+            "KUBEINFER_LOCAL_DEVICE_IDS": "0,1,2,3",
+        })
+        assert cfg == DistributedConfig("10.0.0.1:8476", 2, 4, (0, 1, 2, 3))
+
+    def test_partial_env_fails_loudly(self):
+        with pytest.raises(ValueError, match="partial distributed env"):
+            config_from_env({"KUBEINFER_COORDINATOR": "10.0.0.1:8476"})
+
+    def test_rank_out_of_range_rejected(self):
+        with pytest.raises(ValueError, match="outside"):
+            config_from_env({
+                "KUBEINFER_COORDINATOR": "a:1",
+                "KUBEINFER_PROCESS_ID": "4",
+                "KUBEINFER_NUM_PROCESSES": "4",
+            })
+
+
+class TestInitialize:
+    def test_no_env_is_noop(self):
+        assert distributed.initialize(env={}) is False
+
+    def test_single_process_config_is_noop(self):
+        cfg = DistributedConfig("a:1", 0, 1)
+        assert distributed.initialize(cfg) is False
+
+
+class TestGlobalMesh:
+    def test_single_host_delegates(self):
+        mesh = distributed.global_mesh()
+        assert mesh.axis_names == ("jobs", "nodes")
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_node_axis_constraint(self):
+        mesh = distributed.global_mesh(node_axis=2)
+        assert mesh.shape["nodes"] == 2
+        assert mesh.shape["jobs"] == len(jax.devices()) // 2
+
+    def test_sharded_solve_runs_on_global_mesh(self):
+        """The mesh this module builds must drive the sharded solver."""
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+        from kubeinfer_tpu.solver.sharded import solve_sharded
+
+        rng = np.random.default_rng(0)
+        p = encode_problem_arrays(
+            job_gpu=rng.integers(1, 4, 64).astype(np.float32),
+            job_mem_gib=rng.integers(1, 8, 64).astype(np.float32),
+            node_gpu_free=np.full(16, 8.0, np.float32),
+            node_mem_free_gib=np.full(16, 64.0, np.float32),
+        )
+        out = solve_sharded(p, distributed.global_mesh())
+        assert int(out.placed) > 0
