@@ -110,6 +110,15 @@ class Coordinator:
                 self._runtime_config or RuntimeConfig(model_path=self.model_path)
             )
             self.runtime.start()  # coordinator.go:46-50
+            # Ready must mean "serving": the engine may spend tens of
+            # seconds importing/compiling before it answers (the
+            # reference never waits — its replicas look live while vLLM
+            # is still loading weights).
+            if not self.runtime.wait_healthy():
+                raise RuntimeError(
+                    "inference runtime did not become healthy within "
+                    f"{self.runtime.config.health_timeout_s:.0f}s"
+                )
         self._ready.set()
 
     def shutdown(self) -> None:

@@ -20,7 +20,7 @@ from typing import Callable
 from kubeinfer_tpu import metrics
 from kubeinfer_tpu.agent.model_server import ensure_model_dir
 from kubeinfer_tpu.agent.runtime import RuntimeConfig, RuntimeServer
-from kubeinfer_tpu.agent.transfer import sync_model
+from kubeinfer_tpu.agent.transfer import TransferError, sync_model
 
 log = logging.getLogger(__name__)
 
@@ -65,7 +65,22 @@ class Follower:
                 self.model_path,
             )
         t0 = time.perf_counter()
-        sync_model(self._endpoint, self.model_path, attempts=self._sync_attempts)
+        try:
+            sync_model(
+                self._endpoint, self.model_path, attempts=self._sync_attempts
+            )
+        except TransferError:
+            if not warm:
+                raise
+            # Availability beats freshness for a COMPLETE local copy: a
+            # follower restarting mid-failover (no coordinator resolvable
+            # yet) serves its verified-at-download-time cache rather than
+            # blocking for the whole failover window; the next successful
+            # sync re-verifies checksums.
+            log.warning(
+                "%s: coordinator unreachable; serving existing local copy "
+                "unverified", self.model_path,
+            )
         if not warm:
             metrics.model_download_duration_seconds.observe(
                 "coordinator", time.perf_counter() - t0
@@ -78,6 +93,11 @@ class Follower:
                 self._runtime_config or RuntimeConfig(model_path=self.model_path)
             )
             self.runtime.start()  # follower.go:65-69
+            if not self.runtime.wait_healthy():
+                raise RuntimeError(
+                    "inference runtime did not become healthy within "
+                    f"{self.runtime.config.health_timeout_s:.0f}s"
+                )
         self._ready.set()
 
     def shutdown(self) -> None:

@@ -57,12 +57,21 @@ def file_sha256(path: pathlib.Path) -> str:
     return cached
 
 
-def cached_sha256(path: pathlib.Path, inline_max: int = INLINE_HASH_MAX) -> str:
-    """sha256 if cheap ("" otherwise): cached, or small enough to hash now."""
-    try:
-        st = path.stat()
-    except OSError:
-        return ""
+def cached_sha256(
+    path: pathlib.Path,
+    st: os.stat_result | None = None,
+    inline_max: int = INLINE_HASH_MAX,
+) -> str:
+    """sha256 if cheap ("" otherwise): cached, or small enough to hash now.
+
+    Pass ``st`` when the caller already statted the file (the listing
+    does) to avoid a second syscall per file on a hot endpoint.
+    """
+    if st is None:
+        try:
+            st = path.stat()
+        except OSError:
+            return ""
     hit = _CHECKSUM_CACHE.get((str(path), st.st_size, st.st_mtime_ns))
     if hit is not None:
         return hit
@@ -111,7 +120,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if not p.is_file() or p.name.endswith(".part"):
                 continue
             rel = str(p.relative_to(self.root))
-            entries.append(f"{rel}\t{p.stat().st_size}\t{cached_sha256(p)}")
+            st = p.stat()
+            entries.append(
+                f"{rel}\t{st.st_size}\t{cached_sha256(p, st)}"
+            )
         self._send_text("\n".join(entries) + ("\n" if entries else ""))
 
     def _resolve(self, rel: str) -> pathlib.Path | None:

@@ -85,6 +85,7 @@ class ReplicaAgent:
         self.model_repo = ""
         self.image = ""
         self.cache_shared = False
+        self.workload_env: dict[str, str] = {}
 
     # -- workload record I/O ------------------------------------------------
 
@@ -142,7 +143,10 @@ class ReplicaAgent:
         if self._role_stop is not None:
             self._role_stop.set()
         if self._role_thread is not None:
-            self._role_thread.join(timeout=10)
+            # Join must outlive the runtime stop escalation (SIGTERM grace
+            # 10s + SIGKILL + wait 5s, runtime.py stop): an agent that
+            # exits mid-escalation leaks the runtime subprocess.
+            self._role_thread.join(timeout=20)
         self._role_stop = None
         self._role_thread = None
 
@@ -222,7 +226,21 @@ class ReplicaAgent:
                         return
             if stop.is_set():
                 return
-            follower.start_serving()
+            try:
+                follower.start_serving()
+            except Exception:
+                # runtime never became healthy: release it (same leak/
+                # stale-phase hazards as the coordinator body handles)
+                log.exception("%s: follower runtime failed", self.identity)
+                follower.shutdown()
+                if not stop.is_set():
+                    self._patch_replica(phase="Failed")
+                return
+            if stop.is_set():
+                # role torn down during the (possibly minutes-long) health
+                # wait: a stale Ready here would clobber the successor
+                follower.shutdown()
+                return
             self._patch_replica(phase="Ready")
             stop.wait()
             follower.shutdown()
@@ -276,7 +294,23 @@ class ReplicaAgent:
         self.model_repo = w.model_repo
         self.image = w.image
         self.cache_shared = w.cache_shared
+        self.workload_env = dict(w.env)
         self._cache_group = w.cache_group
+        if self._runtime_config is None and self._start_runtime:
+            # Build the runtime config from the workload's env contract
+            # (the controller injects RUNTIME_KIND / VLLM_* /
+            # MODEL_PATH exactly as the reference injects pod env,
+            # llmservice_controller.go:231-266) layered over process env.
+            import os
+
+            from kubeinfer_tpu.agent.runtime import RuntimeConfig
+
+            merged = {**os.environ, **w.env}
+            # the runtime serves from this replica's node-local cache dir
+            merged["MODEL_PATH"] = model_cache_dir(
+                self._model_root, w.model_repo
+            )
+            self._runtime_config = RuntimeConfig.from_env(merged)
         if self.cache_shared:
             timing_kw = {}
             if self._lease_timings is not None:
@@ -399,7 +433,15 @@ class NodeAgent:
         # replica Starting forever.
         for key, agent in list(self._agents.items()):
             w = want.get(key)
-            if w is None or agent.model_repo != w.model_repo or agent.image != w.image:
+            if (
+                w is None
+                or agent.model_repo != w.model_repo
+                or agent.image != w.image
+                or agent.workload_env != w.env
+            ):
+                # env is part of the restart condition: RUNTIME_KIND /
+                # VLLM_* changes (e.g. runtime: vllm -> native) only take
+                # effect through a role restart, like image changes
                 agent.stop()
                 del self._agents[key]
 

@@ -32,6 +32,11 @@ class RuntimeConfig:
     max_model_len: int = 0  # 0 = server default
     dtype: str = "auto"
     extra_args: list[str] = field(default_factory=list)
+    # Seconds to wait for the spawned server's /health before the replica
+    # is considered Ready (0 disables the wait). Not in the reference —
+    # it never tracks runtime readiness at all; without this the replica
+    # reports Ready while the engine is still importing/compiling.
+    health_timeout_s: float = 180.0
     # Not in the reference: the executable to wrap. Defaults to the vLLM
     # OpenAI server exactly like vllm.go:95; tests override.
     command_prefix: list[str] = field(
@@ -42,9 +47,24 @@ class RuntimeConfig:
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None) -> "RuntimeConfig":
-        """vllm.go:46-80 LoadConfigFromEnv parity (VLLM_* family)."""
+        """vllm.go:46-80 LoadConfigFromEnv parity (VLLM_* family), plus
+        RUNTIME_KIND selecting the engine:
+
+        - ``vllm`` (default): the external vLLM OpenAI server, reference
+          behavior;
+        - ``native``: this framework's TPU-native JAX engine
+          (kubeinfer_tpu.inference.server — same CLI surface);
+        - explicit RUNTIME_COMMAND overrides both.
+        """
         e = os.environ if env is None else env
         cfg = cls()
+        kind = e.get("RUNTIME_KIND", "vllm")
+        if kind == "native":
+            cfg.command_prefix = [
+                sys.executable, "-m", "kubeinfer_tpu.inference.server",
+            ]
+        elif kind != "vllm":
+            raise ValueError(f"unknown RUNTIME_KIND {kind!r}")
         cfg.model_path = e.get("MODEL_PATH", cfg.model_path)
         cfg.host = e.get("VLLM_HOST", cfg.host)
         cfg.port = int(e.get("VLLM_PORT", cfg.port))
@@ -56,6 +76,9 @@ class RuntimeConfig:
         )
         cfg.max_model_len = int(e.get("VLLM_MAX_MODEL_LEN", cfg.max_model_len))
         cfg.dtype = e.get("VLLM_DTYPE", cfg.dtype)
+        cfg.health_timeout_s = float(
+            e.get("VLLM_HEALTH_TIMEOUT_S", cfg.health_timeout_s)
+        )
         extra = e.get("VLLM_EXTRA_ARGS", "")
         if extra:
             cfg.extra_args = shlex.split(extra)
@@ -98,6 +121,39 @@ class RuntimeServer:
 
     def pid(self) -> int | None:
         return self._proc.pid if self._proc else None
+
+    def wait_healthy(self, timeout_s: float | None = None) -> bool:
+        """Poll the spawned server's /health until 200, death, or timeout.
+
+        Works for vLLM, the native engine, and the test mock — all serve
+        GET /health. Returns False (and the process keeps running) on
+        timeout; raises if the process already exited.
+        """
+        import time
+        import urllib.error
+        import urllib.request
+
+        if timeout_s is None:
+            timeout_s = self.config.health_timeout_s
+        if timeout_s <= 0:
+            return True
+        host = self.config.host if self.config.host != "0.0.0.0" else "127.0.0.1"
+        url = f"http://{host}:{self.config.port}/health"
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._proc is not None and self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"runtime exited with code {self._proc.returncode} "
+                    "before becoming healthy"
+                )
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return True
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.5)
+        return False
 
     def running(self) -> bool:
         return self._proc is not None and self._proc.poll() is None

@@ -49,6 +49,18 @@ class CacheStrategy(str, Enum):
     SHARED = "shared"
 
 
+class RuntimeKind(str, Enum):
+    """Which inference engine serves the model.
+
+    ``VLLM``: external vLLM process (reference behavior, vllm.go:95).
+    ``NATIVE``: the framework's TPU-native JAX engine
+    (kubeinfer_tpu.inference).
+    """
+
+    VLLM = "vllm"
+    NATIVE = "native"
+
+
 class SchedulerPolicy(str, Enum):
     """Which SchedulerBackend places this job's replicas.
 
@@ -161,6 +173,9 @@ class LLMServiceSpec:
     priority: int = 0
     gang: bool = False  # all-or-nothing placement of the replica group
     max_model_len: int = 0  # 0 = runtime default
+    # New: which engine serves the model (vllm = reference pass-through,
+    # native = the in-framework TPU engine).
+    runtime: RuntimeKind = RuntimeKind.VLLM
 
     def __post_init__(self) -> None:
         # Defaulting happens at construction so direct construction,
@@ -188,6 +203,10 @@ class LLMServiceSpec:
             raise ValidationError(
                 f"spec.schedulerPolicy must be one of {[p.value for p in SchedulerPolicy]}"
             )
+        if not isinstance(self.runtime, RuntimeKind):
+            raise ValidationError(
+                f"spec.runtime must be one of {[r.value for r in RuntimeKind]}"
+            )
         if self.gpu_memory:
             parse_quantity(self.gpu_memory)
         if self.priority < 0:
@@ -205,6 +224,7 @@ class LLMServiceSpec:
             "priority": self.priority,
             "gang": self.gang,
             "maxModelLen": self.max_model_len,
+            "runtime": self.runtime.value,
         }
 
     @classmethod
@@ -226,6 +246,13 @@ class LLMServiceSpec:
         gpu_memory = d.get("gpuMemory", "") or ""
         if gpu_memory:
             parse_quantity(gpu_memory)  # reject malformed quantities at the boundary
+        try:
+            runtime = RuntimeKind(d.get("runtime", RuntimeKind.VLLM.value))
+        except ValueError:
+            raise ValidationError(
+                f"spec.runtime must be one of {[r.value for r in RuntimeKind]}, "
+                f"got {d.get('runtime')!r}"
+            )
         return cls(
             model=d.get("model", ""),
             replicas=_coerce_int(d.get("replicas", 1), "spec.replicas"),
@@ -237,6 +264,7 @@ class LLMServiceSpec:
             priority=_coerce_int(d.get("priority", 0), "spec.priority"),
             gang=bool(d.get("gang", False)),
             max_model_len=_coerce_int(d.get("maxModelLen", 0), "spec.maxModelLen"),
+            runtime=runtime,
         )
 
 

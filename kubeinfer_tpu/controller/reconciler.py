@@ -96,6 +96,14 @@ class Controller:
                 "CONFIGMAP_NAME": cache_group,
                 "MODEL_PATH": "/models",
                 "MODEL_REPO": svc.spec.model,
+                # engine selection (vllm = reference pass-through,
+                # native = in-framework TPU engine; runtime.py from_env)
+                "RUNTIME_KIND": svc.spec.runtime.value,
+                **(
+                    {"VLLM_MAX_MODEL_LEN": str(svc.spec.max_model_len)}
+                    if svc.spec.max_model_len > 0
+                    else {}
+                ),
             },
             replicas=[ReplicaSpec(index=i) for i in range(svc.spec.replicas)],
         )

@@ -1,0 +1,17 @@
+"""TPU-native inference runtime.
+
+The reference manages inference by exec-ing an external GPU process
+(vLLM — internal/agent/vllm/vllm.go) and owns none of the model compute.
+This package is the TPU-native alternative the framework offers alongside
+that pass-through: a decoder-only transformer (llama-family) implemented
+directly in JAX, sharded over a ``jax.sharding.Mesh`` (tensor parallel
+over heads/ffn, data parallel over batch, ring-attention sequence
+parallel for long context), with a static-shape KV-cache decode engine
+and an OpenAI-compatible HTTP server the agent's runtime launcher can
+spawn exactly like it spawns vLLM.
+"""
+
+from kubeinfer_tpu.inference.config import ModelConfig, PRESETS
+from kubeinfer_tpu.inference.model import forward, init_params
+
+__all__ = ["ModelConfig", "PRESETS", "forward", "init_params"]

@@ -1,0 +1,220 @@
+"""Static-shape generation engine: prefill + KV-cache decode.
+
+XLA-friendly by construction (SURVEY.md §7 / task brief "no
+data-dependent Python control flow inside jit"):
+
+- prompts pad to bucketed lengths (powers of two), so prefill compiles
+  once per bucket;
+- the decode loop is ONE jitted ``lax.scan`` over ``max_new_tokens``
+  steps writing into a fixed-capacity KV cache — no per-token dispatch,
+  no dynamic shapes; finished sequences (EOS) keep stepping but their
+  outputs are masked (the standard static-shape idiom);
+- sampling is greedy or temperature (gumbel trick) selected by a traced
+  scalar, so one compilation serves both.
+
+The engine is deliberately single-batch-slot-array: request batching
+happens by stacking prompts into the [B] axis (the server batches
+per-request today; continuous batching slots into the same static
+shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.model import Params, forward
+
+PROMPT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def _bucket(n: int) -> int:
+    for b in PROMPT_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds {PROMPT_BUCKETS[-1]}")
+
+
+@dataclass
+class GenerationResult:
+    tokens: np.ndarray  # i32[B, max_new] generated ids (EOS-padded)
+    lengths: np.ndarray  # i32[B] generated length per sequence
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new", "cache_len")
+)
+def _generate_jit(
+    params: Params,
+    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    cfg: ModelConfig,
+    max_new: int,
+    cache_len: int,
+    eos_id: jax.Array,  # i32 (negative = never stop)
+    temperature: jax.Array,  # f32; <=0 = greedy
+    rng_key: jax.Array,
+):
+    B, T = prompt.shape
+    D, n_kv = cfg.head_dim, cfg.num_key_value_heads
+    caches = [
+        (
+            jnp.zeros((B, cache_len, n_kv, D), params["norm"].dtype),
+            jnp.zeros((B, cache_len, n_kv, D), params["norm"].dtype),
+        )
+        for _ in range(cfg.num_hidden_layers)
+    ]
+
+    # --- prefill: causal over the bucket, pad rows masked out -----------
+    pos = jnp.arange(T)
+    valid = pos[None, :] < prompt_len[:, None]  # [B, T]
+    mask = (
+        (pos[None, None, :] <= pos[None, :, None])  # causal
+        & valid[:, None, :]
+        & jnp.ones((B, T, 1), bool)
+    )
+    mask = jnp.concatenate(
+        [mask, jnp.zeros((B, T, cache_len - T), bool)], axis=2
+    )
+    logits, caches = forward(
+        params, prompt, cfg, attn_mask=mask, kv_caches=caches, cache_offset=0
+    )
+    # next-token logits come from the LAST REAL prompt position per row
+    last = jnp.clip(prompt_len - 1, 0, T - 1)
+    next_logits = jnp.take_along_axis(
+        logits, last[:, None, None], axis=1
+    )[:, 0]  # [B, V]
+
+    def sample(logits, key):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        g = jax.random.gumbel(key, logits.shape, jnp.float32)
+        temp = jnp.maximum(temperature, 1e-6)
+        sampled = jnp.argmax(
+            logits / temp + g, axis=-1
+        ).astype(jnp.int32)
+        return jnp.where(temperature > 0, sampled, greedy)
+
+    k0, krest = jax.random.split(rng_key)
+    first = sample(next_logits, k0)
+
+    # --- decode scan ----------------------------------------------------
+    def step(carry, key):
+        caches, tok, offset, done = carry
+        step_mask = (jnp.arange(cache_len)[None, None, :] <= offset[:, None, None])
+        logits, caches = forward(
+            params, tok[:, None], cfg,
+            positions=offset[:, None],
+            attn_mask=jnp.broadcast_to(step_mask, (B, 1, cache_len)),
+            kv_caches=caches,
+            # per-row offsets differ (ragged prompts); lax.scan needs ONE
+            # offset for dynamic_update_slice, so rows all write at the
+            # max offset and per-row positions handle RoPE. For exactness
+            # with ragged prompts the engine right-pads prompts so all
+            # rows share the offset (see generate()).
+            cache_offset=offset[0],
+        )
+        nxt = sample(logits[:, 0], key)
+        newly_done = (nxt == eos_id) & (eos_id >= 0)
+        nxt = jnp.where(done, eos_id, nxt)
+        done = done | newly_done
+        return (caches, nxt, offset + 1, done), nxt
+
+    done0 = (first == eos_id) & (eos_id >= 0)
+    if max_new > 1:
+        keys = jax.random.split(krest, max_new - 1)
+        (_, _, _, done), rest = jax.lax.scan(
+            step,
+            (caches, first, prompt_len, done0),
+            keys,
+            length=max_new - 1,
+        )
+        toks = jnp.concatenate(
+            [first[:, None], rest.swapaxes(0, 1)], axis=1
+        )
+    else:
+        toks = first[:, None]
+    # generated length = tokens up to and including first EOS
+    is_eos = (toks == eos_id) & (eos_id >= 0)
+    first_eos = jnp.where(
+        is_eos.any(axis=1), is_eos.argmax(axis=1) + 1, max_new
+    )
+    return toks, first_eos.astype(jnp.int32)
+
+
+class Engine:
+    """Generation front-end over a loaded model."""
+
+    def __init__(self, params: Params, cfg: ModelConfig,
+                 max_cache_len: int = 0) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.max_cache_len = max_cache_len or cfg.max_position_embeddings
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 32,
+        eos_id: int = -1,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> GenerationResult:
+        """Batch generation. Prompts are RIGHT-padded to a shared bucket;
+        all rows then share one cache offset (see _generate_jit.step).
+
+        Right-padding ragged prompts means short rows' first generated
+        token conditions on pad positions — masked out via prompt_len in
+        the prefill mask and per-row last-position logits, so outputs are
+        exact for every row.
+        """
+        if not prompts:
+            return GenerationResult(
+                np.zeros((0, 0), np.int32), np.zeros((0,), np.int32)
+            )
+        B = len(prompts)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        if lens.min() < 1:
+            raise ValueError("empty prompt")
+        T = _bucket(int(lens.max()))
+        need = int(lens.max()) + max_new_tokens
+        if need > self.max_cache_len:
+            raise ValueError(
+                f"prompt+new tokens ({need}) exceed the model's context "
+                f"capacity ({self.max_cache_len})"
+            )
+        # cache width: bucketed for jit-cache reuse, but never below the
+        # prefill bucket T (a cache narrower than the prefill width would
+        # write out of bounds). Bucket rounding may exceed max_cache_len;
+        # positions stay < max_cache_len, extra columns are masked.
+        cache_len = max(T, _bucket(need))
+        padded = np.zeros((B, T), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+
+        # ragged prompts: rows write the cache at their own prefill rows,
+        # but decode writes all rows at offset[0] — exact only when all
+        # rows share a length. The engine therefore pads PROMPTS to the
+        # max row length with repeats of the row's last token... simpler
+        # and exact: run per distinct length group.
+        toks_out = np.zeros((B, max_new_tokens), np.int32)
+        lens_out = np.zeros((B,), np.int32)
+        for L in sorted(set(lens.tolist())):
+            idx = np.nonzero(lens == L)[0]
+            toks, glens = _generate_jit(
+                self.params,
+                jnp.asarray(padded[idx]),
+                jnp.asarray(lens[idx]),
+                self.cfg,
+                max_new_tokens,
+                cache_len,
+                jnp.int32(eos_id),
+                jnp.float32(temperature),
+                jax.random.PRNGKey(seed),
+            )
+            toks_out[idx] = np.asarray(toks)
+            lens_out[idx] = np.asarray(glens)
+        return GenerationResult(toks_out, lens_out)

@@ -1,0 +1,225 @@
+"""Decoder-only transformer (llama family) in pure JAX.
+
+TPU-first choices:
+
+- **bf16 params, f32 accumulations where it matters** (RMSNorm stats and
+  attention softmax run in f32; matmuls feed the MXU in bf16 by default).
+- **Static shapes everywhere**: the forward takes [B, T] tokens plus an
+  explicit position offset so the same compiled function serves prefill
+  (T = padded prompt) and decode (T = 1) with a KV cache.
+- **No module framework**: params are a plain pytree of jnp arrays with
+  HF-compatible naming (weights.py maps safetensors 1:1), so sharding is
+  a tree_map of PartitionSpecs (sharding.py) and checkpoints need no
+  object graph.
+
+Numerical parity with ``transformers`` LlamaForCausalLM is pinned by
+tests/test_inference_model.py (same weights → logits within bf16/f32
+tolerance).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeinfer_tpu.inference.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# --- initialization --------------------------------------------------------
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    """Random init (normal, 0.02 std — HF default) with HF tree layout."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape):
+        return (0.02 * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+    H, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    kv_dim = cfg.num_key_value_heads * cfg.head_dim
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        ks = jax.random.split(jax.random.fold_in(k_layers, i), 7)
+        layers.append(
+            {
+                "input_layernorm": jnp.ones((H,), dtype),
+                "post_attention_layernorm": jnp.ones((H,), dtype),
+                # weights stored [in, out] (transposed vs torch Linear) so
+                # the forward is x @ W with no per-call transpose
+                "q_proj": dense(ks[0], (H, H)),
+                "k_proj": dense(ks[1], (H, kv_dim)),
+                "v_proj": dense(ks[2], (H, kv_dim)),
+                "o_proj": dense(ks[3], (H, H)),
+                "gate_proj": dense(ks[4], (H, F)),
+                "up_proj": dense(ks[5], (H, F)),
+                "down_proj": dense(ks[6], (F, H)),
+            }
+        )
+    params: Params = {
+        "embed_tokens": dense(k_embed, (V, H)),
+        "layers": layers,
+        "norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(k_head, (H, V))
+    return params
+
+
+# --- building blocks -------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with f32 statistics regardless of activation dtype."""
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return ((xf * scale) * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables for rotary embeddings at given positions [B, T]."""
+    inv_freq = 1.0 / (
+        theta
+        ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [B, T, heads, head_dim] by position tables [B, T, head_dim/2].
+
+    HF llama convention: the head dim is split into halves (x1 = first
+    half, x2 = second half), not interleaved pairs.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def attention(
+    q: jax.Array,  # [B, T, n_heads, D]
+    k: jax.Array,  # [B, S, n_kv, D]
+    v: jax.Array,  # [B, S, n_kv, D]
+    mask: jax.Array,  # bool[B, T, S] True = attend
+) -> jax.Array:
+    """GQA scaled-dot-product attention, f32 softmax, [B, T, n_heads, D]."""
+    B, T, n_heads, D = q.shape
+    n_kv = k.shape[2]
+    group = n_heads // n_kv
+    # fold heads into kv groups: [B, T, n_kv, group, D]
+    qg = q.reshape(B, T, n_kv, group, D)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(D))
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, n_heads, D)
+
+
+def decoder_layer(
+    layer: Params,
+    x: jax.Array,  # [B, T, H]
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,
+    cfg: ModelConfig,
+    kv_cache: tuple[jax.Array, jax.Array] | None = None,
+    cache_offset: jax.Array | int = 0,
+    attn_fn=attention,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
+    """One pre-norm block; returns (x, updated kv cache or None)."""
+    B, T, H = x.shape
+    D = cfg.head_dim
+    h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+    q = (h @ layer["q_proj"]).reshape(B, T, cfg.num_attention_heads, D)
+    k = (h @ layer["k_proj"]).reshape(B, T, cfg.num_key_value_heads, D)
+    v = (h @ layer["v_proj"]).reshape(B, T, cfg.num_key_value_heads, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if kv_cache is not None:
+        ck, cv = kv_cache
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+        k, v = ck, cv
+        kv_cache = (ck, cv)
+
+    attn = attn_fn(q, k, v, mask)
+    x = x + attn.reshape(B, T, H) @ layer["o_proj"]
+
+    h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(h @ layer["gate_proj"])
+    x = x + (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
+    return x, kv_cache
+
+
+# --- full forward ----------------------------------------------------------
+
+
+def causal_mask(T: int, dtype=bool) -> jax.Array:
+    return jnp.tril(jnp.ones((T, T), dtype))
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # i32[B, T]
+    cfg: ModelConfig,
+    positions: jax.Array | None = None,  # i32[B, T]; default arange
+    attn_mask: jax.Array | None = None,  # bool[B, T, S]
+    kv_caches: list[tuple[jax.Array, jax.Array]] | None = None,
+    cache_offset: jax.Array | int = 0,
+    attn_fn=attention,
+) -> tuple[jax.Array, list | None]:
+    """Logits [B, T, V] (+ updated KV caches when provided).
+
+    Without caches: plain causal self-attention over T (prefill/training).
+    With caches: keys/values are written at ``cache_offset`` and attention
+    runs over the full cache length (decode); ``attn_mask`` must then mask
+    cache positions ≥ the true length.
+    """
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :] + cache_offset
+        positions = jnp.broadcast_to(positions, (B, T))
+    if attn_mask is None:
+        if kv_caches is not None:
+            raise ValueError("decode with kv_caches requires attn_mask")
+        attn_mask = jnp.broadcast_to(causal_mask(T)[None], (B, T, T))
+
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    x = params["embed_tokens"][tokens]
+    new_caches = [] if kv_caches is not None else None
+    for i, layer in enumerate(params["layers"]):
+        cache = kv_caches[i] if kv_caches is not None else None
+        x, cache = decoder_layer(
+            layer, x, cos, sin, attn_mask, cfg,
+            kv_cache=cache, cache_offset=cache_offset, attn_fn=attn_fn,
+        )
+        if new_caches is not None:
+            new_caches.append(cache)
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    head = (
+        params["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else params["lm_head"]
+    )
+    logits = (x @ head).astype(jnp.float32)
+    return logits, new_caches
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_jit(params: Params, tokens: jax.Array, cfg: ModelConfig):
+    """Jitted no-cache forward (training/prefill compile target)."""
+    return forward(params, tokens, cfg)[0]

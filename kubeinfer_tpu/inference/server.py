@@ -1,0 +1,256 @@
+"""OpenAI-compatible HTTP server over the native engine.
+
+``python -m kubeinfer_tpu.inference.server`` accepts the SAME CLI surface
+the agent's runtime launcher builds for vLLM (runtime.py build_args —
+--model/--host/--port/--tensor-parallel-size/--dtype/[--max-model-len]),
+so switching a workload to the native TPU runtime is just
+``RUNTIME_KIND=native`` (or ``runtime: native`` in the LLMService spec) —
+lifecycle code is untouched.
+
+Endpoints (the surface the reference's mock pins, testdata
+vllm-mock/mock_server.py, plus real generation):
+
+- ``GET  /health``            → OK
+- ``GET  /v1/models``         → OpenAI-style model list
+- ``POST /v1/completions``    → {model, prompt: str|[int], max_tokens,
+                                temperature, seed} → completion
+
+String prompts need tokenizer files next to the weights (loaded via
+``transformers`` AutoTokenizer); token-id prompts always work (and are
+what the tests and the e2e slice use). ``--random-init`` serves a
+randomly initialized preset config — the demo/e2e mode that needs no
+weights and no network, the role the reference's vllm-mock image plays,
+except it really generates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from http.server import ThreadingHTTPServer
+
+from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler
+
+log = logging.getLogger(__name__)
+
+
+class InferenceServer:
+    def __init__(self, engine, model_id: str, tokenizer=None,
+                 host: str = "127.0.0.1", port: int = 8000) -> None:
+        self.engine = engine
+        self.model_id = model_id
+        self.tokenizer = tokenizer
+        server = self
+
+        class Handler(BaseEndpointHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/health":
+                    self.respond(200, "text/plain", "OK")
+                elif path == "/v1/models":
+                    self.respond(200, "application/json", json.dumps({
+                        "object": "list",
+                        "data": [{
+                            "id": server.model_id,
+                            "object": "model",
+                            "owned_by": "kubeinfer-tpu",
+                        }],
+                    }))
+                else:
+                    self.respond(404, "text/plain", "not found\n")
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                if path != "/v1/completions":
+                    self.respond(404, "text/plain", "not found\n")
+                    return
+                try:
+                    body = json.loads(raw or b"{}")
+                    resp = server.complete(body)
+                    self.respond(200, "application/json", json.dumps(resp))
+                except ValueError as e:
+                    self.respond(400, "application/json", json.dumps(
+                        {"error": {"message": str(e), "type": "invalid_request_error"}}
+                    ))
+                except Exception as e:  # keep the serving thread alive
+                    log.exception("completion failed")
+                    self.respond(500, "application/json", json.dumps(
+                        {"error": {"message": str(e), "type": "server_error"}}
+                    ))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # -- request handling --------------------------------------------------
+
+    def _encode(self, prompt) -> list[int]:
+        if isinstance(prompt, list):
+            if not all(isinstance(t, int) for t in prompt):
+                raise ValueError("prompt list must contain token ids (ints)")
+            return prompt
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompts require tokenizer files next to the "
+                    "model weights; this server was started without them — "
+                    "send token ids instead"
+                )
+            return self.tokenizer.encode(prompt)
+        raise ValueError("prompt must be a string or a list of token ids")
+
+    def _decode(self, ids: list[int]) -> str:
+        if self.tokenizer is None:
+            return " ".join(str(i) for i in ids)
+        return self.tokenizer.decode(ids)
+
+    def complete(self, body: dict) -> dict:
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise ValueError("'prompt' is required")
+        ids = self._encode(prompt)
+        max_tokens = int(body.get("max_tokens", 16))
+        if not (0 < max_tokens <= 4096):
+            raise ValueError("max_tokens must be in (0, 4096]")
+        temperature = float(body.get("temperature", 0.0))
+        seed = int(body.get("seed", 0))
+        eos_id = -1
+        if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
+            eos_id = int(self.tokenizer.eos_token_id)
+
+        out = self.engine.generate(
+            [ids], max_new_tokens=max_tokens, eos_id=eos_id,
+            temperature=temperature, seed=seed,
+        )
+        gen = out.tokens[0, : out.lengths[0]].tolist()
+        return {
+            "id": "cmpl-kubeinfer",
+            "object": "text_completion",
+            "model": self.model_id,
+            "choices": [{
+                "index": 0,
+                "text": self._decode(gen),
+                "tokens": gen,
+                "finish_reason": (
+                    "stop" if out.lengths[0] < max_tokens else "length"
+                ),
+            }],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(gen),
+                "total_tokens": len(ids) + len(gen),
+            },
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"inference-server-{self.port}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _load_tokenizer(model_dir: str):
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_dir)
+    except Exception as e:
+        log.warning("no tokenizer loaded from %s (%s); id-only mode", model_dir, e)
+        return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="kubeinfer-inference-server")
+    # flag surface = runtime.py build_args (vllm.go:93-112 parity)
+    p.add_argument("--model", required=True,
+                   help="model dir (HF snapshot) or preset name with "
+                        "--random-init")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--gpu-memory-utilization", type=float, default=0.9)
+    p.add_argument("--dtype", default="auto",
+                   choices=["auto", "bfloat16", "float32"])
+    p.add_argument("--max-model-len", type=int, default=0)
+    p.add_argument("--random-init", action="store_true",
+                   help="serve a randomly initialized --model preset "
+                        "(demo/e2e mode; no weights needed)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference.config import PRESETS
+    from kubeinfer_tpu.inference.engine import Engine
+    from kubeinfer_tpu.inference.model import init_params
+
+    dtype = {"auto": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+             "float32": jnp.float32}[args.dtype]
+    tokenizer = None
+    if args.random_init:
+        # --model may be a preset name or (when the lifecycle layer passes
+        # a cache dir, e.g. the mock-download e2e flow) any path: fall
+        # back to the CI-sized preset.
+        cfg = PRESETS.get(args.model)
+        if cfg is None:
+            log.info("--random-init: %r is not a preset; using 'tiny'",
+                     args.model)
+            cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    else:
+        from kubeinfer_tpu.inference.weights import load_pretrained
+
+        params, cfg = load_pretrained(args.model, dtype=dtype)
+        tokenizer = _load_tokenizer(args.model)
+    if args.max_model_len > 0:
+        max_cache = args.max_model_len
+    else:
+        max_cache = cfg.max_position_embeddings
+
+    if args.tensor_parallel_size > 1:
+        # place params on a tp mesh; GSPMD partitions the jitted forward
+        from kubeinfer_tpu.inference.sharding import (
+            make_inference_mesh, shard_params,
+        )
+
+        mesh = make_inference_mesh(tp=args.tensor_parallel_size, sp=1, dp=1)
+        params = shard_params(params, mesh, cfg)
+
+    engine = Engine(params, cfg, max_cache_len=max_cache)
+    srv = InferenceServer(
+        engine, model_id=args.model, tokenizer=tokenizer,
+        host=args.host, port=args.port,
+    ).start()
+    log.info("native inference server on %s:%d (model %s)",
+             args.host, srv.port, args.model)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    while not stop.is_set():
+        stop.wait(0.5)
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Sharding for the native runtime: TP param specs + SP forward.
+
+Tensor parallel (the reference's ``--tensor-parallel-size`` is a
+pass-through flag to external vLLM, vllm.go:57-61; here TP is real):
+attention heads and ffn columns shard over the ``tp`` mesh axis. With
+column-parallel (q/k/v/gate/up) then row-parallel (o/down) weights, the
+only collectives GSPMD must insert are the two per-block psums of the
+standard Megatron layout — we annotate the params and let the partitioner
+do exactly that (scaling-book recipe: annotate, don't hand-schedule).
+
+Sequence parallel: ``forward_sequence_parallel`` runs the whole decoder
+under ``shard_map`` with the sequence axis sharded over ``sp``, swapping
+the dense attention for ring attention (ring_attention.py). Weights are
+replicated across ``sp``; activations never materialize the full
+sequence on one device — this is the long-context path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.model import Params, forward
+from kubeinfer_tpu.inference.ring_attention import ring_attention
+
+
+def make_inference_mesh(
+    tp: int = 1, sp: int = 1, dp: int | None = None
+) -> Mesh:
+    """(dp, tp, sp) mesh over the available devices (dp fills the rest)."""
+    import numpy as np
+
+    devices = jax.devices()
+    if dp is None:
+        dp = len(devices) // (tp * sp)
+    n = dp * tp * sp
+    if n > len(devices) or n < 1:
+        raise ValueError(
+            f"mesh dp={dp} tp={tp} sp={sp} needs {n} devices, have "
+            f"{len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices[:n]).reshape(dp, tp, sp),
+        axis_names=("dp", "tp", "sp"),
+    )
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree matching init_params' layout (Megatron TP)."""
+    layer = {
+        "input_layernorm": P(),
+        "post_attention_layernorm": P(),
+        "q_proj": P(None, "tp"),  # column parallel: heads shard
+        "k_proj": P(None, "tp"),
+        "v_proj": P(None, "tp"),
+        "o_proj": P("tp", None),  # row parallel: psum after
+        "gate_proj": P(None, "tp"),
+        "up_proj": P(None, "tp"),
+        "down_proj": P("tp", None),
+    }
+    return {
+        "embed_tokens": P(None, None),  # replicated (small vs the ffn)
+        "layers": [layer] * cfg.num_hidden_layers,
+        "norm": P(),
+        "lm_head": P(None, "tp"),  # vocab-sharded logits
+    }
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    """Place a param pytree onto the mesh per param_specs."""
+    specs = param_specs(cfg)
+    if "lm_head" not in params:
+        specs = dict(specs)
+        specs.pop("lm_head")
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def forward_tensor_parallel(
+    params: Params, tokens: jax.Array, cfg: ModelConfig, mesh: Mesh
+) -> jax.Array:
+    """Jit the standard forward with TP-sharded params; GSPMD inserts the
+    Megatron psums. ``params`` should already be placed (shard_params) —
+    then this is zero-copy; unplaced params are placed on trace."""
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def fwd(p, t, cfg: ModelConfig):
+        out, _ = forward(p, t, cfg)
+        return jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P("dp", None, None))
+        )
+
+    tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P("dp", None))
+    )
+    return fwd(shard_params(params, mesh, cfg), tokens, cfg)
+
+
+def forward_sequence_parallel(
+    params: Params, tokens: jax.Array, cfg: ModelConfig, mesh: Mesh
+) -> jax.Array:
+    """Causal LM forward with the SEQUENCE axis sharded over ``sp``.
+
+    The full decoder body runs per-shard under shard_map (pointwise over
+    T except attention, which is the ring). RoPE positions are global:
+    each shard computes them from its axis index. T must divide by the
+    sp axis size.
+    """
+    B, T = tokens.shape
+    sp = mesh.shape["sp"]
+    if T % sp:
+        raise ValueError(f"sequence length {T} must divide by sp={sp}")
+    T_loc = T // sp
+
+    def body(p, t_local):
+        r = jax.lax.axis_index("sp")
+        positions = (
+            r * T_loc + jnp.arange(T_loc, dtype=jnp.int32)[None, :]
+        )
+        positions = jnp.broadcast_to(positions, t_local.shape)
+
+        def ring_fn(q, k, v, mask):  # model's mask is local-only: ignore;
+            # causality comes from global positions inside the ring
+            del mask
+            return ring_attention(q, k, v, axis_name="sp")
+
+        # the local mask arg is unused by ring_fn but must have the
+        # local shape for the (ignored) broadcast in attention()'s twin
+        local_mask = jnp.ones((t_local.shape[0], T_loc, T_loc), bool)
+        out, _ = forward(
+            p, t_local, cfg, positions=positions, attn_mask=local_mask,
+            attn_fn=ring_fn,
+        )
+        return out
+
+    shard_fwd = jax.jit(
+        jax.shard_map(
+            functools.partial(body),
+            mesh=mesh,
+            in_specs=(param_specs_replicated(cfg, params), P(None, "sp")),
+            out_specs=P(None, "sp", None),
+        )
+    )
+    return shard_fwd(params, tokens)
+
+
+def param_specs_replicated(cfg: ModelConfig, params: Params) -> Params:
+    """All-replicated spec tree (shard_map in_specs for the SP path)."""
+    specs = jax.tree.map(lambda _: P(), params)
+    return specs

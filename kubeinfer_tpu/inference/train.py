@@ -1,0 +1,66 @@
+"""Training step for the native model (fine-tuning / the dryrun's full
+multi-parallel step).
+
+Plain causal-LM loss with the standard sharded-training layout: params
+carry their TP PartitionSpecs (sharding.py), the batch shards over
+``dp``, and XLA's partitioner inserts the gradient psums — no hand-rolled
+collectives (scaling-book recipe). ``jax.checkpoint`` on the per-layer
+body trades FLOPs for memory exactly where long sequences need it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.model import Params, forward
+
+
+def causal_lm_loss(
+    params: Params, tokens: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    """Mean next-token cross entropy over [B, T] (targets = shift-left)."""
+    logits, _ = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def train_step(
+    params: Params, tokens: jax.Array, cfg: ModelConfig, lr: float = 1e-3
+) -> tuple[Params, jax.Array]:
+    """One SGD step; params are donated (updated in place on device)."""
+    loss, grads = jax.value_and_grad(causal_lm_loss)(params, tokens, cfg)
+    new_params = jax.tree.map(
+        lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype),
+        params, grads,
+    )
+    return new_params, loss
+
+
+def sharded_train_step(mesh: Mesh, cfg: ModelConfig):
+    """Jitted train step for TP-sharded params + dp-sharded batch.
+
+    Returns ``step(params, tokens) -> (params, loss)``; place params with
+    sharding.shard_params and tokens with P("dp", None) first — GSPMD
+    propagates those input shardings through grads and the update, so
+    updated params keep their TP placement (the donate keeps them
+    in-place on device across steps). Forward psums come from the
+    Megatron layout; gradient reductions over dp are inserted by the
+    partitioner.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(params: Params, tokens: jax.Array):
+        new_params, loss = train_step(params, tokens, cfg)
+        return new_params, jax.lax.with_sharding_constraint(
+            loss, NamedSharding(mesh, P())
+        )
+
+    return step

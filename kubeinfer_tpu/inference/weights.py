@@ -1,0 +1,98 @@
+"""HuggingFace checkpoint loading for the native runtime.
+
+Maps a llama-family HF checkpoint (config.json + *.safetensors, exactly
+what the coordinator's ``huggingface-cli download`` drops into the model
+cache — coordinator.go:99-105 parity path) onto model.py's param pytree.
+Torch Linear weights are [out, in]; ours are [in, out] so the forward is
+``x @ W`` — every projection transposes once at load time, never at
+inference time.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.model import Params
+
+
+def _to_np(t) -> np.ndarray:
+    """Tensor-ish (torch / numpy / jax) -> numpy, bf16-safe."""
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "detach"):  # torch
+        t = t.detach()
+        if t.dtype.__str__() == "torch.bfloat16":
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def params_from_state_dict(
+    sd: Mapping[str, object], cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF llama state dict (name -> tensor) -> model.py param pytree."""
+
+    def get(name: str) -> np.ndarray:
+        for key in (name, f"model.{name}"):
+            if key in sd:
+                return _to_np(sd[key])
+        raise KeyError(f"checkpoint missing tensor {name!r}")
+
+    def linear(name: str) -> jnp.ndarray:
+        return jnp.asarray(get(name).T, dtype)  # [out,in] -> [in,out]
+
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"layers.{i}"
+        layers.append(
+            {
+                "input_layernorm": jnp.asarray(
+                    get(f"{p}.input_layernorm.weight"), dtype
+                ),
+                "post_attention_layernorm": jnp.asarray(
+                    get(f"{p}.post_attention_layernorm.weight"), dtype
+                ),
+                "q_proj": linear(f"{p}.self_attn.q_proj.weight"),
+                "k_proj": linear(f"{p}.self_attn.k_proj.weight"),
+                "v_proj": linear(f"{p}.self_attn.v_proj.weight"),
+                "o_proj": linear(f"{p}.self_attn.o_proj.weight"),
+                "gate_proj": linear(f"{p}.mlp.gate_proj.weight"),
+                "up_proj": linear(f"{p}.mlp.up_proj.weight"),
+                "down_proj": linear(f"{p}.mlp.down_proj.weight"),
+            }
+        )
+    params: Params = {
+        "embed_tokens": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "layers": layers,
+        "norm": jnp.asarray(get("norm.weight"), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = linear("lm_head.weight")
+    return params
+
+
+def load_pretrained(
+    model_dir: str, dtype=jnp.bfloat16
+) -> tuple[Params, ModelConfig]:
+    """Load (params, config) from an HF snapshot directory."""
+    root = pathlib.Path(model_dir)
+    with open(root / "config.json", "r", encoding="utf-8") as f:
+        cfg = ModelConfig.from_hf_dict(json.load(f))
+
+    from safetensors import safe_open
+
+    sd: dict[str, np.ndarray] = {}
+    shards = sorted(root.glob("*.safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {model_dir}")
+    for shard in shards:
+        with safe_open(str(shard), framework="np") as f:
+            for name in f.keys():
+                sd[name] = f.get_tensor(name)
+    return params_from_state_dict(sd, cfg, dtype), cfg

@@ -1,0 +1,152 @@
+"""Native inference server: endpoint surface + runtime-launcher integration.
+
+The endpoint surface is the one the reference's mock pins
+(test/testdata/vllm-mock/mock_server.py: /health, /v1/models) plus real
+/v1/completions; the integration test proves the agent's RuntimeServer
+can spawn the native engine via RUNTIME_KIND=native with zero lifecycle
+changes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeinfer_tpu.agent.runtime import RuntimeConfig
+from kubeinfer_tpu.inference import PRESETS, init_params
+from kubeinfer_tpu.inference.engine import Engine
+from kubeinfer_tpu.inference.server import InferenceServer
+
+TINY = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    srv = InferenceServer(
+        Engine(params, TINY), model_id="tiny-test", port=0
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def get(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read() or b"null")
+
+
+def post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestEndpoints:
+    def test_health(self, server):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=10
+        ) as r:
+            assert r.read() == b"OK"  # mock_server.py:8-15 parity
+
+    def test_models_list(self, server):
+        status, body = get(f"http://127.0.0.1:{server.port}/v1/models")
+        assert status == 200
+        assert body["object"] == "list"
+        assert body["data"][0]["id"] == "tiny-test"  # mock_server.py:17-29
+
+    def test_completion_with_token_ids(self, server):
+        status, body = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        )
+        assert status == 200
+        choice = body["choices"][0]
+        assert len(choice["tokens"]) == 4
+        assert body["usage"] == {
+            "prompt_tokens": 4, "completion_tokens": 4, "total_tokens": 8,
+        }
+        # deterministic greedy: same request → same tokens
+        _, body2 = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        )
+        assert body2["choices"][0]["tokens"] == choice["tokens"]
+
+    def test_string_prompt_without_tokenizer_rejected(self, server):
+        status, body = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": "hello", "max_tokens": 2},
+        )
+        assert status == 400
+        assert "tokenizer" in body["error"]["message"]
+
+    def test_missing_prompt_rejected(self, server):
+        status, _ = post(
+            f"http://127.0.0.1:{server.port}/v1/completions", {"max_tokens": 2}
+        )
+        assert status == 400
+
+
+class TestRuntimeLauncherIntegration:
+    def test_runtime_kind_native_spawns_real_server(self, tmp_path):
+        """RUNTIME_KIND=native + the standard env contract boots the
+        native engine as a subprocess through the unchanged RuntimeServer
+        lifecycle (vllm.go Start/Stop parity)."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        cfg = RuntimeConfig.from_env({
+            "RUNTIME_KIND": "native",
+            "MODEL_PATH": "tiny",  # preset name + --random-init below
+            "VLLM_HOST": "127.0.0.1",
+            "VLLM_PORT": str(port),
+            "VLLM_EXTRA_ARGS": "--random-init",
+            "VLLM_DTYPE": "float32",
+        })
+        assert cfg.command_prefix[-1] == "kubeinfer_tpu.inference.server"
+
+        from kubeinfer_tpu.agent.runtime import RuntimeServer
+
+        srv = RuntimeServer(cfg)
+        srv.start()
+        try:
+            deadline = time.monotonic() + 120
+            up = False
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health", timeout=2
+                    ) as r:
+                        up = r.read() == b"OK"
+                        break
+                except OSError:
+                    time.sleep(0.5)
+            assert up, "native runtime never became healthy"
+            status, body = post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                {"prompt": [5, 6, 7], "max_tokens": 3},
+            )
+            assert status == 200
+            assert len(body["choices"][0]["tokens"]) == 3
+        finally:
+            srv.stop()
+        assert not srv.running()
+
+    def test_unknown_runtime_kind_rejected(self):
+        with pytest.raises(ValueError, match="RUNTIME_KIND"):
+            RuntimeConfig.from_env({"RUNTIME_KIND": "tgi"})
