@@ -12,6 +12,9 @@ duties, so its env surface covers node identity and capacity:
   MODEL_PATH           model cache root (default /models, ref parity)
   GPU_CAPACITY         schedulable chip count (default 8)
   GPU_MEMORY           per-node accelerator memory, e.g. 16Gi (default 16Gi)
+  AUTO_DETECT_ACCELERATORS  "1": observe local JAX devices (chip count +
+                       HBM) instead of the GPU_CAPACITY/GPU_MEMORY env
+                       (explicit env still wins when both are set)
   TOPOLOGY             "rack,island" coordinates (default 0,0)
   HEARTBEAT_INTERVAL_S node-state heartbeat period (default 10)
   START_RUNTIMES       "1" to exec real inference runtimes (default 0)
@@ -58,6 +61,21 @@ def main() -> int:
     model_root = os.environ.get("MODEL_PATH", "/models")
     gpu_capacity = float(os.environ.get("GPU_CAPACITY", "8"))
     gpu_memory = parse_quantity(os.environ.get("GPU_MEMORY", "16Gi"))
+    if os.environ.get("AUTO_DETECT_ACCELERATORS", "0") == "1":
+        from kubeinfer_tpu.agent.probe import probe_accelerators
+
+        info = probe_accelerators()
+        if info is not None:
+            log.info(
+                "observed %d %s device(s), %.1f GiB HBM",
+                info.count, info.platform, info.memory_bytes / 2**30,
+            )
+            if "GPU_CAPACITY" not in os.environ:
+                gpu_capacity = float(info.count)
+            if "GPU_MEMORY" not in os.environ and info.memory_bytes:
+                gpu_memory = info.memory_bytes
+        else:
+            log.warning("AUTO_DETECT_ACCELERATORS=1 but no devices observed")
     topo = [int(x) for x in os.environ.get("TOPOLOGY", "0,0").split(",")]
     interval = float(os.environ.get("HEARTBEAT_INTERVAL_S", "10"))
     start_runtimes = os.environ.get("START_RUNTIMES", "0") == "1"

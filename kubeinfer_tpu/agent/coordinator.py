@@ -97,8 +97,12 @@ class Coordinator:
             "hub", time.perf_counter() - t0
         )
 
-    def run_prepare(self) -> None:
-        """Non-blocking setup: model present, server + runtime started."""
+    def run_prepare(self, cancel=None) -> None:
+        """Setup: model present, server + runtime started and healthy.
+
+        ``cancel`` (threading.Event) aborts the health wait early —
+        role teardown must not block for the full health timeout.
+        """
         self.ensure_model()
         if self._serve_model:
             self.model_server = ModelServer(
@@ -114,10 +118,11 @@ class Coordinator:
             # seconds importing/compiling before it answers (the
             # reference never waits — its replicas look live while vLLM
             # is still loading weights).
-            if not self.runtime.wait_healthy():
+            if not self.runtime.wait_healthy(cancel=cancel):
                 raise RuntimeError(
-                    "inference runtime did not become healthy within "
-                    f"{self.runtime.config.health_timeout_s:.0f}s"
+                    "inference runtime did not become healthy (timeout "
+                    f"{self.runtime.config.health_timeout_s:.0f}s or role "
+                    "torn down)"
                 )
         self._ready.set()
 

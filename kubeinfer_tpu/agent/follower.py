@@ -20,7 +20,11 @@ from typing import Callable
 from kubeinfer_tpu import metrics
 from kubeinfer_tpu.agent.model_server import ensure_model_dir
 from kubeinfer_tpu.agent.runtime import RuntimeConfig, RuntimeServer
-from kubeinfer_tpu.agent.transfer import TransferError, sync_model
+from kubeinfer_tpu.agent.transfer import (
+    TransferError,
+    sync_complete,
+    sync_model,
+)
 
 log = logging.getLogger(__name__)
 
@@ -70,33 +74,37 @@ class Follower:
                 self._endpoint, self.model_path, attempts=self._sync_attempts
             )
         except TransferError:
-            if not warm:
+            # Availability beats freshness — but ONLY for a provably
+            # COMPLETE copy (the sync-complete marker; a non-empty dir
+            # alone can be a killed multi-file sync whose every present
+            # file is whole): a follower restarting mid-failover serves
+            # its verified-at-download-time cache rather than blocking
+            # for the whole failover window; the next successful sync
+            # re-verifies checksums.
+            if not (warm and sync_complete(self.model_path)):
                 raise
-            # Availability beats freshness for a COMPLETE local copy: a
-            # follower restarting mid-failover (no coordinator resolvable
-            # yet) serves its verified-at-download-time cache rather than
-            # blocking for the whole failover window; the next successful
-            # sync re-verifies checksums.
             log.warning(
-                "%s: coordinator unreachable; serving existing local copy "
-                "unverified", self.model_path,
+                "%s: coordinator unreachable; serving existing complete "
+                "local copy unverified", self.model_path,
             )
         if not warm:
             metrics.model_download_duration_seconds.observe(
                 "coordinator", time.perf_counter() - t0
             )
 
-    def start_serving(self) -> None:
-        """Start the runtime once the model is in place."""
+    def start_serving(self, cancel=None) -> None:
+        """Start the runtime once the model is in place; ``cancel``
+        aborts the health wait on role teardown."""
         if self._start_runtime:
             self.runtime = RuntimeServer(
                 self._runtime_config or RuntimeConfig(model_path=self.model_path)
             )
             self.runtime.start()  # follower.go:65-69
-            if not self.runtime.wait_healthy():
+            if not self.runtime.wait_healthy(cancel=cancel):
                 raise RuntimeError(
-                    "inference runtime did not become healthy within "
-                    f"{self.runtime.config.health_timeout_s:.0f}s"
+                    "inference runtime did not become healthy (timeout "
+                    f"{self.runtime.config.health_timeout_s:.0f}s or role "
+                    "torn down)"
                 )
         self._ready.set()
 

@@ -117,7 +117,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         """
         entries = []
         for p in sorted(self.root.rglob("*")):
-            if not p.is_file() or p.name.endswith(".part"):
+            # dotfiles are local bookkeeping (e.g. the sync-complete
+            # marker) and must not propagate through the plane
+            if not p.is_file() or p.name.endswith(".part") or p.name.startswith("."):
                 continue
             rel = str(p.relative_to(self.root))
             st = p.stat()
@@ -207,7 +209,11 @@ class ModelServer:
     def _warm_checksums(self) -> None:
         try:
             for p in sorted(self._root.rglob("*")):
-                if p.is_file() and not p.name.endswith(".part"):
+                if (
+                    p.is_file()
+                    and not p.name.endswith(".part")
+                    and not p.name.startswith(".")
+                ):
                     file_sha256(p)
         except OSError:
             pass  # dir vanished mid-walk; next listing reflects reality
@@ -225,7 +231,10 @@ def ensure_model_dir(path: str) -> bool:
     download looks 'cached'; the transfer layer writes .part files and
     renames on completion so partials are never counted)."""
     try:
-        entries = [p for p in os.listdir(path) if not p.endswith(".part")]
+        entries = [
+            p for p in os.listdir(path)
+            if not p.endswith(".part") and not p.startswith(".")
+        ]
     except FileNotFoundError:
         return False
     return len(entries) > 0

@@ -176,7 +176,7 @@ class ReplicaAgent:
 
         def body(stop: threading.Event) -> None:
             try:
-                coord.run_prepare()
+                coord.run_prepare(cancel=stop)
             except Exception:
                 log.exception("%s: coordinator prepare failed", self.identity)
                 # Release whatever run_prepare started (the model server may
@@ -227,7 +227,7 @@ class ReplicaAgent:
             if stop.is_set():
                 return
             try:
-                follower.start_serving()
+                follower.start_serving(cancel=stop)
             except Exception:
                 # runtime never became healthy: release it (same leak/
                 # stale-phase hazards as the coordinator body handles)
@@ -265,7 +265,7 @@ class ReplicaAgent:
 
         def body(stop: threading.Event) -> None:
             try:
-                coord.run_prepare()
+                coord.run_prepare(cancel=stop)
             except Exception:
                 log.exception("%s: model download failed", self.identity)
                 coord.shutdown()

@@ -122,12 +122,18 @@ class RuntimeServer:
     def pid(self) -> int | None:
         return self._proc.pid if self._proc else None
 
-    def wait_healthy(self, timeout_s: float | None = None) -> bool:
-        """Poll the spawned server's /health until 200, death, or timeout.
+    def wait_healthy(
+        self, timeout_s: float | None = None, cancel=None
+    ) -> bool:
+        """Poll the spawned server's /health until 200, death, timeout,
+        or ``cancel`` (a threading.Event) is set.
 
         Works for vLLM, the native engine, and the test mock — all serve
         GET /health. Returns False (and the process keeps running) on
-        timeout; raises if the process already exited.
+        timeout or cancellation; raises if the process already exited.
+        The cancel hook matters for role teardown: without it a role
+        restart would block behind a (possibly minutes-long) health wait
+        while the old runtime still owns the serving port.
         """
         import time
         import urllib.error
@@ -141,6 +147,8 @@ class RuntimeServer:
         url = f"http://{host}:{self.config.port}/health"
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            if cancel is not None and cancel.is_set():
+                return False
             if self._proc is not None and self._proc.poll() is not None:
                 raise RuntimeError(
                     f"runtime exited with code {self._proc.returncode} "
@@ -152,7 +160,11 @@ class RuntimeServer:
                         return True
             except (urllib.error.URLError, OSError):
                 pass
-            time.sleep(0.5)
+            if cancel is not None:
+                if cancel.wait(0.5):
+                    return False
+            else:
+                time.sleep(0.5)
         return False
 
     def running(self) -> bool:

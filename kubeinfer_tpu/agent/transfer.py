@@ -22,15 +22,29 @@ from __future__ import annotations
 
 import hashlib
 import http.client
+import json
 import os
 import pathlib
 import time
 import urllib.parse
 from dataclasses import dataclass
 
+# Written into the model dir after a FULLY verified sync; its presence is
+# the only thing that distinguishes "complete local copy" from "partial
+# sync that happens to contain whole files" (each file lands atomically,
+# so a killed multi-file sync leaves a non-empty dir with no .part
+# files). Dotfiles are excluded from listings/cache checks, so the
+# marker never propagates through the distribution plane.
+SYNC_MARKER = ".kubeinfer-sync-complete"
+
 
 class TransferError(RuntimeError):
     pass
+
+
+def sync_complete(dest_dir: str) -> bool:
+    """True iff a previous sync_model finished verifying every file."""
+    return (pathlib.Path(dest_dir) / SYNC_MARKER).exists()
 
 
 @dataclass(frozen=True)
@@ -192,6 +206,13 @@ def sync_model(
                             f"{entry.path}: checksum mismatch after download "
                             f"(got {got[:12]}…, want {entry.sha256[:12]}…)"
                         )
+            marker = pathlib.Path(dest_dir) / SYNC_MARKER
+            marker.write_text(json.dumps({
+                "files": [
+                    {"path": e.path, "size": e.size, "sha256": e.sha256}
+                    for e in entries
+                ],
+            }))
             return [e.path for e in entries]
         except (TransferError, OSError, http.client.HTTPException) as e:
             last = e

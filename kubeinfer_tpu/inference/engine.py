@@ -30,7 +30,10 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.model import Params, forward
 
-PROMPT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+PROMPT_BUCKETS = (
+    16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768,
+    65536, 131072,  # long-context models advertise up to 128k positions
+)
 
 
 def _bucket(n: int) -> int:
@@ -73,11 +76,8 @@ def _generate_jit(
     # --- prefill: causal over the bucket, pad rows masked out -----------
     pos = jnp.arange(T)
     valid = pos[None, :] < prompt_len[:, None]  # [B, T]
-    mask = (
-        (pos[None, None, :] <= pos[None, :, None])  # causal
-        & valid[:, None, :]
-        & jnp.ones((B, T, 1), bool)
-    )
+    mask = (pos[None, None, :] <= pos[None, :, None]) & valid[:, None, :]
+    mask = jnp.broadcast_to(mask, (B, T, T))
     mask = jnp.concatenate(
         [mask, jnp.zeros((B, T, cache_len - T), bool)], axis=2
     )
@@ -111,11 +111,9 @@ def _generate_jit(
             positions=offset[:, None],
             attn_mask=jnp.broadcast_to(step_mask, (B, 1, cache_len)),
             kv_caches=caches,
-            # per-row offsets differ (ragged prompts); lax.scan needs ONE
-            # offset for dynamic_update_slice, so rows all write at the
-            # max offset and per-row positions handle RoPE. For exactness
-            # with ragged prompts the engine right-pads prompts so all
-            # rows share the offset (see generate()).
+            # dynamic_update_slice takes ONE offset for the whole batch,
+            # so every row must share it — generate() guarantees this by
+            # solving each distinct prompt length as its own batch.
             cache_offset=offset[0],
         )
         nxt = sample(logits[:, 0], key)
@@ -163,13 +161,12 @@ class Engine:
         temperature: float = 0.0,
         seed: int = 0,
     ) -> GenerationResult:
-        """Batch generation. Prompts are RIGHT-padded to a shared bucket;
-        all rows then share one cache offset (see _generate_jit.step).
+        """Batch generation, exact for ragged prompts.
 
-        Right-padding ragged prompts means short rows' first generated
-        token conditions on pad positions — masked out via prompt_len in
-        the prefill mask and per-row last-position logits, so outputs are
-        exact for every row.
+        Prompts pad to a shared bucket for the prefill (pad columns
+        masked via prompt_len); the decode scan requires one shared
+        cache offset per call, so rows are grouped by distinct prompt
+        length and each group solves in its own jit invocation.
         """
         if not prompts:
             return GenerationResult(
@@ -195,11 +192,6 @@ class Engine:
         for i, p in enumerate(prompts):
             padded[i, : len(p)] = p
 
-        # ragged prompts: rows write the cache at their own prefill rows,
-        # but decode writes all rows at offset[0] — exact only when all
-        # rows share a length. The engine therefore pads PROMPTS to the
-        # max row length with repeats of the row's last token... simpler
-        # and exact: run per distinct length group.
         toks_out = np.zeros((B, max_new_tokens), np.int32)
         lens_out = np.zeros((B,), np.int32)
         for L in sorted(set(lens.tolist())):

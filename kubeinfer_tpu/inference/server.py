@@ -133,6 +133,11 @@ class InferenceServer:
             temperature=temperature, seed=seed,
         )
         gen = out.tokens[0, : out.lengths[0]].tolist()
+        # "stop" iff the sequence actually terminated on EOS — including
+        # EOS landing exactly on the max_tokens-th token (a length-based
+        # test would mislabel that and invite clients to auto-continue a
+        # finished sequence)
+        stopped = eos_id >= 0 and bool(gen) and gen[-1] == eos_id
         return {
             "id": "cmpl-kubeinfer",
             "object": "text_completion",
@@ -141,9 +146,7 @@ class InferenceServer:
                 "index": 0,
                 "text": self._decode(gen),
                 "tokens": gen,
-                "finish_reason": (
-                    "stop" if out.lengths[0] < max_tokens else "length"
-                ),
+                "finish_reason": "stop" if stopped else "length",
             }],
             "usage": {
                 "prompt_tokens": len(ids),

@@ -67,6 +67,7 @@ def _bid_kernel(
     d_ref,  # [1, TILE_J] f32 gpu demand
     md_ref,  # [1, TILE_J] f32 mem demand
     rankf_ref,  # [1, TILE_J] f32 fence rank, RANK_INF when may-not-bid
+    cur_ref,  # [1, TILE_J] i32 incumbent node index, -1 = none
     gf_ref,  # [TILE_N, 1] f32 gpu free (invalid nodes pre-folded to -1)
     mf_ref,  # [TILE_N, 1] f32 mem free
     u_ref,  # [TILE_N, 1] f32 live best-fit pressure
@@ -89,13 +90,18 @@ def _bid_kernel(
     mf = mf_ref[:]
 
     feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, TILE_J]
-    # Per-node priority fence: bid only if no higher-priority unplaced job
-    # finds this node feasible anywhere in [0, J). RANK_INF rows drop out.
-    allowed = feas & (rankf <= minrank_ref[:]) & (rankf < rank_inf * 0.5)
-
     q = jnp.clip((s_ref[:] + u_ref[:] - q_lo) * q_scale, 0.0, q_max)
     n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
         jnp.int32, feas.shape, 0
+    )
+    # Per-node priority fence: bid only if no higher-priority unplaced job
+    # finds this node feasible anywhere in [0, J). RANK_INF rows drop out.
+    # Incumbents are exempt on their OWN node (core._round_bids_jnp twin).
+    is_home = cur_ref[:] == n_glob
+    allowed = (
+        feas
+        & ((rankf <= minrank_ref[:]) | is_home)
+        & (rankf < rank_inf * 0.5)
     )
     packed = jnp.where(
         allowed,
@@ -119,6 +125,7 @@ def bid_reduce_pallas(
     md: jax.Array,  # [J]
     rankf_eff: jax.Array,  # [J] (RANK_INF when may-not-bid)
     minrank: jax.Array,  # [N] fence minimum over all jobs
+    current_node: jax.Array,  # i32[J] incumbent node index, -1 = none
     *,
     q_lo: float,
     q_scale: float,
@@ -164,6 +171,7 @@ def bid_reduce_pallas(
             row,  # d
             row,  # md
             row,  # rankf
+            row,  # current_node
             col,  # gf
             col,  # mf
             col,  # u
@@ -182,6 +190,7 @@ def bid_reduce_pallas(
         d.reshape(1, J),
         md.reshape(1, J),
         rankf_eff.reshape(1, J),
+        current_node.reshape(1, J),
         gf_eff.reshape(N, 1),
         mf.reshape(N, 1),
         u.reshape(N, 1),

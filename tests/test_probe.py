@@ -1,0 +1,20 @@
+"""Hardware probe tests (runs on the virtual CPU mesh)."""
+
+from __future__ import annotations
+
+from kubeinfer_tpu.agent.probe import probe_accelerators, probe_host_memory
+
+
+def test_probe_sees_local_devices():
+    info = probe_accelerators()
+    assert info is not None
+    # conftest forces an 8-device virtual CPU mesh
+    assert info.count == 8
+    assert info.platform == "cpu"
+
+
+def test_probe_host_memory_on_linux():
+    mem = probe_host_memory()
+    assert mem is not None
+    total, avail = mem
+    assert total > 0 and 0 < avail <= total
