@@ -185,6 +185,47 @@ def churn_bench(backend, J=10_000, N=1_000, steps=8, churn_frac=0.1, seed=5):
     }
 
 
+def inference_bench(short_new=8, long_new=128, prompt_len=512):
+    """Native-engine decode throughput on the live device.
+
+    Times generate() at two max_new_tokens values; the difference is
+    pure decode-scan device time (each call is ONE dispatch+readback, so
+    the transport round trip and the shared prefill cancel exactly —
+    same trick as device_solve_ms).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.engine import Engine
+
+    cfg = PRESETS["bench-280m"]
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    engine = Engine(params, cfg)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+
+    # compile both variants
+    engine.generate([prompt], max_new_tokens=short_new)
+    engine.generate([prompt], max_new_tokens=long_new)
+    shorts, longs = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        engine.generate([prompt], max_new_tokens=short_new)
+        shorts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        engine.generate([prompt], max_new_tokens=long_new)
+        longs.append(time.perf_counter() - t0)
+    dt = statistics.median(longs) - statistics.median(shorts)
+    steps = long_new - short_new
+    per_step_ms = max(dt, 1e-9) / steps * 1e3
+    return {
+        "model": "bench-280m",
+        "decode_ms_per_token": round(per_step_ms, 3),
+        "decode_tokens_per_sec": round(1e3 / per_step_ms, 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -258,6 +299,16 @@ def main() -> None:
         extras["cfg_churn_p50_ms"] = round(churn["p50_ms"], 3)
         extras["cfg_churn_moved_frac"] = churn["moved_frac"]
         extras["cfg_churn_placed"] = churn["placed"]
+        # flagship-model serving throughput on the same device
+        try:
+            inf = inference_bench()
+            extras["native_engine_model"] = inf["model"]
+            extras["native_engine_decode_ms_per_token"] = inf[
+                "decode_ms_per_token"]
+            extras["native_engine_decode_tokens_per_sec"] = inf[
+                "decode_tokens_per_sec"]
+        except Exception as e:  # bench must always emit its JSON line
+            extras["native_engine_error"] = f"{type(e).__name__}: {e}"
 
     print(
         json.dumps(

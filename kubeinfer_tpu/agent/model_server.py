@@ -117,9 +117,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         """
         entries = []
         for p in sorted(self.root.rglob("*")):
-            # dotfiles are local bookkeeping (e.g. the sync-complete
-            # marker) and must not propagate through the plane
-            if not p.is_file() or p.name.endswith(".part") or p.name.startswith("."):
+            # dot-prefixed paths (ANY component: .kubeinfer-sync-complete,
+            # .cache/huggingface/...) are local bookkeeping and must not
+            # propagate through the plane
+            if not p.is_file() or p.name.endswith(".part"):
+                continue
+            if any(
+                part.startswith(".")
+                for part in p.relative_to(self.root).parts
+            ):
                 continue
             rel = str(p.relative_to(self.root))
             st = p.stat()
@@ -212,7 +218,10 @@ class ModelServer:
                 if (
                     p.is_file()
                     and not p.name.endswith(".part")
-                    and not p.name.startswith(".")
+                    and not any(
+                        part.startswith(".")
+                        for part in p.relative_to(self._root).parts
+                    )
                 ):
                     file_sha256(p)
         except OSError:

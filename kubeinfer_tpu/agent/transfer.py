@@ -187,6 +187,11 @@ def sync_model(
             if not ep:
                 raise TransferError("no coordinator endpoint available")
             entries = fetch_file_list(ep)
+            # Invalidate the completion marker BEFORE any mutation: a
+            # re-sync that dies halfway (file deleted on checksum
+            # mismatch, download failed) must not leave a stale marker
+            # vouching for a mixed-version dir.
+            (pathlib.Path(dest_dir) / SYNC_MARKER).unlink(missing_ok=True)
             for entry in entries:
                 dest = pathlib.Path(dest_dir) / entry.path
                 if dest.exists():

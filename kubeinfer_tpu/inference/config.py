@@ -67,6 +67,18 @@ PRESETS: dict[str, ModelConfig] = {
         num_key_value_heads=2,
         max_position_embeddings=512,
     ),
+    # benchmark-sized model (~280M params): big enough that decode is
+    # HBM-bound like production models, small enough to init on-chip in
+    # seconds
+    "bench-280m": ModelConfig(
+        vocab_size=32000,
+        hidden_size=1024,
+        intermediate_size=4096,
+        num_hidden_layers=16,
+        num_attention_heads=16,
+        num_key_value_heads=8,
+        max_position_embeddings=4096,
+    ),
     "llama-3-8b": ModelConfig(
         vocab_size=128256,
         hidden_size=4096,

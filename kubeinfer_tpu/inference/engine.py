@@ -205,7 +205,10 @@ class Engine:
                 cache_len,
                 jnp.int32(eos_id),
                 jnp.float32(temperature),
-                jax.random.PRNGKey(seed),
+                # fold the group length in: identical keys across length
+                # groups would sample rows of different groups in
+                # lockstep (within a group the batch axis decorrelates)
+                jax.random.fold_in(jax.random.PRNGKey(seed), L),
             )
             toks_out[idx] = np.asarray(toks)
             lens_out[idx] = np.asarray(glens)

@@ -428,6 +428,10 @@ class TestPallasParity:
             job_mem_gib=rng.integers(4, 64, J).astype(np.float32),
             job_priority=rng.integers(0, 4, J).astype(np.float32),
             job_model=rng.integers(0, 16, J).astype(np.int32),
+            # incumbents exercise the kernels' home-bid fence exemption
+            job_current_node=np.where(
+                rng.random(J) < 0.5, rng.integers(0, N, J), -1
+            ).astype(np.int32),
             node_gpu_free=np.full(N, 16.0, np.float32),
             node_mem_free_gib=np.full(N, 128.0, np.float32),
             node_cached=(rng.random((N, 16)) < 0.1),
@@ -456,6 +460,9 @@ class TestPallasParity:
             job_mem_gib=rng.integers(4, 64, J).astype(np.float32),
             job_priority=rng.integers(0, 4, J).astype(np.float32),
             job_model=rng.integers(0, 16, J).astype(np.int32),
+            job_current_node=np.where(
+                rng.random(J) < 0.5, rng.integers(0, N, J), -1
+            ).astype(np.int32),
             node_gpu_free=np.full(N, 16.0, np.float32),
             node_mem_free_gib=np.full(N, 128.0, np.float32),
             node_cached=(rng.random((N, 16)) < 0.1),
