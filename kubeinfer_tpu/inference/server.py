@@ -40,8 +40,10 @@ log = logging.getLogger(__name__)
 
 class InferenceServer:
     def __init__(self, engine, model_id: str, tokenizer=None,
-                 host: str = "127.0.0.1", port: int = 8000) -> None:
+                 host: str = "127.0.0.1", port: int = 8000,
+                 continuous=None) -> None:
         self.engine = engine
+        self.continuous = continuous  # ContinuousEngine | None
         self.model_id = model_id
         self.tokenizer = tokenizer
         server = self
@@ -128,11 +130,25 @@ class InferenceServer:
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
             eos_id = int(self.tokenizer.eos_token_id)
 
-        out = self.engine.generate(
-            [ids], max_new_tokens=max_tokens, eos_id=eos_id,
-            temperature=temperature, seed=seed,
-        )
-        gen = out.tokens[0, : out.lengths[0]].tolist()
+        if (
+            self.continuous is not None
+            and temperature <= 0
+            and self.continuous.fits(len(ids), max_tokens)
+        ):
+            # greedy requests ride the shared continuous-batching slots:
+            # concurrent clients decode together instead of serializing.
+            # Requests beyond slot width (long context) fall through to
+            # the per-request engine, which serves the model's full
+            # context.
+            gen = self.continuous.generate(
+                ids, max_new_tokens=max_tokens, eos_id=eos_id
+            )
+        else:
+            out = self.engine.generate(
+                [ids], max_new_tokens=max_tokens, eos_id=eos_id,
+                temperature=temperature, seed=seed,
+            )
+            gen = out.tokens[0, : out.lengths[0]].tolist()
         # "stop" iff the sequence actually terminated on EOS — including
         # EOS landing exactly on the max_tokens-th token (a length-based
         # test would mislabel that and invite clients to auto-continue a
@@ -196,6 +212,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--random-init", action="store_true",
                    help="serve a randomly initialized --model preset "
                         "(demo/e2e mode; no weights needed)")
+    p.add_argument("--batch-slots", type=int, default=8,
+                   help="continuous-batching decode slots for greedy "
+                        "requests (0 disables)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -239,9 +258,17 @@ def main(argv: list[str] | None = None) -> int:
         params = shard_params(params, mesh, cfg)
 
     engine = Engine(params, cfg, max_cache_len=max_cache)
+    continuous = None
+    if args.batch_slots > 0:
+        from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+        continuous = ContinuousEngine(
+            params, cfg, n_slots=args.batch_slots,
+            cache_len=min(max_cache, 4096),
+        ).start()
     srv = InferenceServer(
         engine, model_id=args.model, tokenizer=tokenizer,
-        host=args.host, port=args.port,
+        host=args.host, port=args.port, continuous=continuous,
     ).start()
     log.info("native inference server on %s:%d (model %s)",
              args.host, srv.port, args.model)
@@ -252,6 +279,8 @@ def main(argv: list[str] | None = None) -> int:
     while not stop.is_set():
         stop.wait(0.5)
     srv.stop()
+    if continuous is not None:
+        continuous.stop()
     return 0
 
 

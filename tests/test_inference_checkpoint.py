@@ -1,0 +1,69 @@
+"""Checkpoint round-trip tests (orbax), including sharded restore."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from kubeinfer_tpu.inference import PRESETS, init_params  # noqa: E402
+from kubeinfer_tpu.inference.checkpoint import (
+    restore_checkpoint,
+    save_checkpoint,
+)
+from kubeinfer_tpu.inference.sharding import make_inference_mesh
+
+TINY = PRESETS["tiny"]
+
+
+def assert_trees_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+def test_roundtrip(tmp_path):
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    save_checkpoint(str(tmp_path / "ckpt"), params, TINY, step=17)
+    restored, cfg, step = restore_checkpoint(str(tmp_path / "ckpt"))
+    assert step == 17
+    assert cfg == TINY
+    assert_trees_equal(params, restored)
+
+
+def test_sharded_restore_lands_on_mesh(tmp_path):
+    params = init_params(TINY, jax.random.PRNGKey(1))
+    save_checkpoint(str(tmp_path / "ckpt"), params, TINY, step=3)
+    mesh = make_inference_mesh(tp=2, sp=1, dp=4)
+    restored, cfg, step = restore_checkpoint(str(tmp_path / "ckpt"), mesh=mesh)
+    assert step == 3
+    assert_trees_equal(params, restored)
+    # TP placement applied: q_proj shards over the tp axis
+    sh = restored["layers"][0]["q_proj"].sharding
+    assert sh.spec == jax.sharding.PartitionSpec(None, "tp")
+
+
+def test_resume_training_continues(tmp_path):
+    from kubeinfer_tpu.inference.train import train_step
+
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, TINY.vocab_size, (2, 12)), np.int32)
+    params = init_params(TINY, jax.random.PRNGKey(2))
+    params, _ = train_step(params, toks, TINY)
+    save_checkpoint(str(tmp_path / "ckpt"), params, TINY, step=1)
+    restored, _, step = restore_checkpoint(str(tmp_path / "ckpt"))
+    _, loss_a = train_step(restored, toks, TINY)
+    params_b = init_params(TINY, jax.random.PRNGKey(2))
+    params_b, _ = train_step(params_b, toks, TINY)
+    _, loss_b = train_step(params_b, toks, TINY)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+def test_restore_missing_path_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "nope"))

@@ -100,11 +100,26 @@ class TestEndpoints:
 
 
 class TestRuntimeLauncherIntegration:
-    def test_runtime_kind_native_spawns_real_server(self, tmp_path):
+    def test_runtime_kind_native_spawns_real_server(self, tmp_path, monkeypatch):
         """RUNTIME_KIND=native + the standard env contract boots the
         native engine as a subprocess through the unchanged RuntimeServer
         lifecycle (vllm.go Start/Stop parity)."""
+        import os
         import socket
+
+        # This test box injects a sitecustomize via PYTHONPATH that
+        # imports jax against an experimental remote-TPU relay at
+        # interpreter startup — child startup then depends on relay load
+        # (observed: 20s to never). Scrub it; deployment machines have
+        # no such path.
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        scrubbed = os.pathsep.join(
+            p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        )
+        monkeypatch.setenv(
+            "PYTHONPATH", scrubbed + (os.pathsep if scrubbed else "") + repo
+        )
 
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))

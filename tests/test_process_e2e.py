@@ -60,10 +60,16 @@ def http_get(url: str, token: str = "") -> tuple[int, str]:
 @pytest.fixture()
 def subprocess_env(tmp_path):
     env = dict(os.environ)
-    # subprocesses must not touch the experimental axon TPU tunnel
+    # subprocesses must not touch the experimental axon TPU tunnel — and
+    # must not inherit this box's axon sitecustomize via PYTHONPATH
+    # (its startup jax import can hang on relay load)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rest = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + rest)
     return env
 
 
