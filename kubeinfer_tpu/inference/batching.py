@@ -69,13 +69,6 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
     )
 
 
-def _row_update(cache: jax.Array, new: jax.Array, offset: jax.Array):
-    """Write new [B, T, kv, D] at per-row offsets (vmapped DUS)."""
-    return jax.vmap(
-        lambda c, n, o: jax.lax.dynamic_update_slice(c, n, (o, 0, 0))
-    )(cache, new, offset)
-
-
 @functools.partial(
     jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
 )
@@ -89,51 +82,23 @@ def _decode_step(
     """
     B = state.last_token.shape[0]
     S = state.caches_k[0].shape[1]
-    positions = state.offset[:, None]
     mask = (jnp.arange(S)[None, None, :] < (state.offset + 1)[:, None, None])
     mask = jnp.broadcast_to(mask, (B, 1, S))
 
-    # run the forward manually so each layer's cache update uses the
-    # per-row writer (model.forward's cache path assumes one shared
-    # offset); the inline body must stay op-for-op with
-    # model.decoder_layer
-    from kubeinfer_tpu.inference.model import rms_norm, rope_tables
-
-    tokens = state.last_token[:, None]
-    cos, sin = rope_tables(
-        jnp.broadcast_to(positions, (B, 1)), cfg.head_dim, cfg.rope_theta
+    # model.forward handles per-row cache offsets natively (decoder_layer
+    # vmaps the cache write when cache_offset is a vector)
+    logits, caches = forward(
+        params,
+        state.last_token[:, None],
+        cfg,
+        positions=state.offset[:, None],
+        attn_mask=mask,
+        kv_caches=list(zip(state.caches_k, state.caches_v)),
+        cache_offset=state.offset,
     )
-    x = params["embed_tokens"][tokens]
-    new_k, new_v = [], []
-    for i, layer in enumerate(params["layers"]):
-        # inline the layer body with row-wise cache semantics
-        h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
-        D = cfg.head_dim
-        q = (h @ layer["q_proj"]).reshape(B, 1, cfg.num_attention_heads, D)
-        k = (h @ layer["k_proj"]).reshape(B, 1, cfg.num_key_value_heads, D)
-        v = (h @ layer["v_proj"]).reshape(B, 1, cfg.num_key_value_heads, D)
-        from kubeinfer_tpu.inference.model import apply_rope, attention
-
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        ck = _row_update(state.caches_k[i], k, state.offset)
-        cv = _row_update(state.caches_v[i], v, state.offset)
-        new_k.append(ck)
-        new_v.append(cv)
-        attn = attention(q, ck, cv, mask)
-        x = x + attn.reshape(B, 1, cfg.hidden_size) @ layer["o_proj"]
-        h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(h @ layer["gate_proj"])
-        x = x + (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
-
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
-    head = (
-        params["embed_tokens"].T
-        if cfg.tie_word_embeddings
-        else params["lm_head"]
-    )
-    logits = (x @ head).astype(jnp.float32)[:, 0]
-    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_k = [c[0] for c in caches]
+    new_v = [c[1] for c in caches]
+    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
 
     keep = state.active
     new_state = SlotState(
@@ -209,6 +174,13 @@ class _Request:
     eos_id: int
     out_tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self) -> None:
+        """Abandon the request: the scheduler drops it before admission
+        or retires its slot at the next step, instead of decoding tokens
+        nobody will read."""
+        self.cancelled.set()
 
 
 class ContinuousEngine:
@@ -262,6 +234,7 @@ class ContinuousEngine:
                  eos_id: int = -1, timeout: float = 300.0) -> list[int]:
         req = self.submit(prompt, max_new_tokens, eos_id)
         if not req.done.wait(timeout):
+            req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
         return req.out_tokens
 
@@ -276,6 +249,18 @@ class ContinuousEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # release every waiter: queued requests never admitted and
+        # in-slot requests mid-decode would otherwise block their
+        # callers for the full generate() timeout
+        while True:
+            try:
+                self._queue.get_nowait().done.set()
+            except queue.Empty:
+                break
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                self._slot_req[slot] = None
+                req.done.set()
 
     # -- scheduler loop ---------------------------------------------------
 
@@ -297,9 +282,13 @@ class ContinuousEngine:
         req = self._slot_req[slot]
         if req is None:
             return
-        finished = len(req.out_tokens) >= req.max_new or (
-            req.eos_id >= 0 and req.out_tokens
-            and req.out_tokens[-1] == req.eos_id
+        finished = (
+            req.cancelled.is_set()
+            or len(req.out_tokens) >= req.max_new
+            or (
+                req.eos_id >= 0 and req.out_tokens
+                and req.out_tokens[-1] == req.eos_id
+            )
         )
         if finished:
             self._slot_req[slot] = None
@@ -315,6 +304,7 @@ class ContinuousEngine:
     def _loop(self) -> None:
         while not self._stop.is_set():
             # admit as many pending requests as there are free slots
+            # (cancelled-before-admission requests are dropped)
             admitted = False
             for slot in range(self.n_slots):
                 if self._slot_req[slot] is None:
@@ -322,6 +312,9 @@ class ContinuousEngine:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
+                    if req.cancelled.is_set():
+                        req.done.set()
+                        continue
                     self._admit(slot, req)
                     admitted = True
             if not any(r is not None for r in self._slot_req):
@@ -330,6 +323,9 @@ class ContinuousEngine:
                     try:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
+                        continue
+                    if req.cancelled.is_set():
+                        req.done.set()
                         continue
                     self._admit(0, req)
                 continue

@@ -151,8 +151,21 @@ def decoder_layer(
 
     if kv_cache is not None:
         ck, cv = kv_cache
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+        if getattr(cache_offset, "ndim", 0) == 1:
+            # per-row offsets (continuous-batching slots at different
+            # sequence positions): vmapped row-wise update
+            def row_update(cache, new):
+                return jax.vmap(
+                    lambda c, n, o: jax.lax.dynamic_update_slice(
+                        c, n, (o, 0, 0)
+                    )
+                )(cache, new, cache_offset)
+
+            ck = row_update(ck, k)
+            cv = row_update(cv, v)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
         k, v = ck, cv
         kv_cache = (ck, cv)
 
@@ -191,8 +204,11 @@ def forward(
     """
     B, T = tokens.shape
     if positions is None:
-        positions = jnp.arange(T, dtype=jnp.int32)[None, :] + cache_offset
-        positions = jnp.broadcast_to(positions, (B, T))
+        base = jnp.arange(T, dtype=jnp.int32)[None, :]
+        if getattr(cache_offset, "ndim", 0) == 1:
+            positions = base + cache_offset[:, None]  # per-row offsets
+        else:
+            positions = jnp.broadcast_to(base + cache_offset, (B, T))
     if attn_mask is None:
         if kv_caches is not None:
             raise ValueError("decode with kv_caches requires attn_mask")

@@ -32,3 +32,17 @@ assert jax.devices()[0].platform == "cpu", (
 assert len(jax.devices()) == 8, jax.devices()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scrubbed_pythonpath() -> str:
+    """PYTHONPATH for spawned subprocesses: repo first, this box's axon
+    sitecustomize removed (its interpreter-startup jax import dials an
+    experimental remote-TPU relay and can stall children for minutes).
+    One copy here so every subprocess-spawning test agrees."""
+    rest = [
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ]
+    return os.pathsep.join([REPO_ROOT] + rest)

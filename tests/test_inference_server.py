@@ -104,22 +104,11 @@ class TestRuntimeLauncherIntegration:
         """RUNTIME_KIND=native + the standard env contract boots the
         native engine as a subprocess through the unchanged RuntimeServer
         lifecycle (vllm.go Start/Stop parity)."""
-        import os
         import socket
 
-        # This test box injects a sitecustomize via PYTHONPATH that
-        # imports jax against an experimental remote-TPU relay at
-        # interpreter startup — child startup then depends on relay load
-        # (observed: 20s to never). Scrub it; deployment machines have
-        # no such path.
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        scrubbed = os.pathsep.join(
-            p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p
-        )
-        monkeypatch.setenv(
-            "PYTHONPATH", scrubbed + (os.pathsep if scrubbed else "") + repo
-        )
+        from tests.conftest import scrubbed_pythonpath
+
+        monkeypatch.setenv("PYTHONPATH", scrubbed_pythonpath())
 
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))

@@ -59,17 +59,15 @@ def http_get(url: str, token: str = "") -> tuple[int, str]:
 
 @pytest.fixture()
 def subprocess_env(tmp_path):
+    from tests.conftest import scrubbed_pythonpath
+
     env = dict(os.environ)
     # subprocesses must not touch the experimental axon TPU tunnel — and
     # must not inherit this box's axon sitecustomize via PYTHONPATH
     # (its startup jax import can hang on relay load)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    rest = [
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p
-    ]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + rest)
+    env["PYTHONPATH"] = scrubbed_pythonpath()
     return env
 
 
