@@ -65,12 +65,20 @@ def load_token(path: str) -> str:
 
 
 class StoreServer:
-    """Serves a Store over HTTP and republishes its watch stream."""
+    """Serves a Store over HTTP and republishes its watch stream.
+
+    ``solve_handler`` (optional) exposes the scheduler as an RPC:
+    ``POST /solve`` with a JSON problem → assignment (SURVEY.md §7 step
+    3's solve-service boundary; the in-process dispatch the manager's
+    own reconciler uses stays the fast path — this endpoint is for
+    EXTERNAL controllers that want placements without embedding JAX).
+    """
 
     def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 0,
-                 token: str = "") -> None:
+                 token: str = "", solve_handler=None) -> None:
         self._store = store
         self._token = token
+        self._solve_handler = solve_handler
         # Event ring: long-pollers replay from here by resourceVersion.
         self._events: collections.deque[WatchEvent] = collections.deque(
             maxlen=EVENT_LOG_SIZE
@@ -138,6 +146,14 @@ class StoreServer:
                                 for e in evs
                             ],
                         })
+                    elif parts == ["solve"] and method == "POST":
+                        if server._solve_handler is None:
+                            self._drop_body()
+                            self._send(404, {"error": "no solver attached"})
+                        else:
+                            self._send(
+                                200, server._solve_handler(self._body())
+                            )
                     elif len(parts) == 2 and parts[0] == "apis":
                         kind = parts[1]
                         if method == "GET":

@@ -175,6 +175,9 @@ class _Request:
     out_tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # set instead of a normal completion when the engine shut down
+    # mid-flight — truncated output must not look like success
+    failed: str = ""
 
     def cancel(self) -> None:
         """Abandon the request: the scheduler drops it before admission
@@ -201,6 +204,10 @@ class ContinuousEngine:
         self._slot_req: list[_Request | None] = [None] * n_slots
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # guards _slot_req and request result mutation between the
+        # scheduler loop and stop()'s cleanup (the join below can time
+        # out behind a long jit compile, leaving both threads live)
+        self._lock = threading.Lock()
 
     # -- public API -------------------------------------------------------
 
@@ -236,6 +243,8 @@ class ContinuousEngine:
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
+        if req.failed:
+            raise RuntimeError(req.failed)
         return req.out_tokens
 
     def start(self) -> "ContinuousEngine":
@@ -249,18 +258,23 @@ class ContinuousEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
-        # release every waiter: queued requests never admitted and
-        # in-slot requests mid-decode would otherwise block their
-        # callers for the full generate() timeout
+        # release every waiter AS FAILURES: queued requests never
+        # admitted and in-slot requests mid-decode would otherwise block
+        # their callers for the full generate() timeout — and a
+        # truncated token list must not read as a normal completion
         while True:
             try:
-                self._queue.get_nowait().done.set()
+                req = self._queue.get_nowait()
             except queue.Empty:
                 break
-        for slot, req in enumerate(self._slot_req):
-            if req is not None:
-                self._slot_req[slot] = None
-                req.done.set()
+            req.failed = "engine stopped before the request was served"
+            req.done.set()
+        with self._lock:
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    self._slot_req[slot] = None
+                    req.failed = "engine stopped mid-generation"
+                    req.done.set()
 
     # -- scheduler loop ---------------------------------------------------
 
@@ -305,37 +319,43 @@ class ContinuousEngine:
         while not self._stop.is_set():
             # admit as many pending requests as there are free slots
             # (cancelled-before-admission requests are dropped)
-            admitted = False
-            for slot in range(self.n_slots):
-                if self._slot_req[slot] is None:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
-                    if req.cancelled.is_set():
-                        req.done.set()
-                        continue
-                    self._admit(slot, req)
-                    admitted = True
-            if not any(r is not None for r in self._slot_req):
+            with self._lock:
+                admitted = False
+                for slot in range(self.n_slots):
+                    if self._slot_req[slot] is None:
+                        try:
+                            req = self._queue.get_nowait()
+                        except queue.Empty:
+                            break
+                        if req.cancelled.is_set():
+                            req.done.set()
+                            continue
+                        self._admit(slot, req)
+                        admitted = True
+                busy = any(r is not None for r in self._slot_req)
+            if not busy:
                 if not admitted:
                     # idle: block briefly for work
                     try:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         continue
-                    if req.cancelled.is_set():
-                        req.done.set()
-                        continue
-                    self._admit(0, req)
+                    with self._lock:
+                        if req.cancelled.is_set():
+                            req.done.set()
+                            continue
+                        self._admit(0, req)
                 continue
 
+            # device step outside the lock (it can block on a compile;
+            # stop() must still be able to fail over the slots)
             self._state, tokens = _decode_step(
                 self.params, self._state, self.cfg
             )
             toks = np.asarray(tokens)
-            for slot in range(self.n_slots):
-                req = self._slot_req[slot]
-                if req is not None and toks[slot] >= 0:
-                    req.out_tokens.append(int(toks[slot]))
-                    self._maybe_retire(slot)
+            with self._lock:
+                for slot in range(self.n_slots):
+                    req = self._slot_req[slot]
+                    if req is not None and toks[slot] >= 0:
+                        req.out_tokens.append(int(toks[slot]))
+                        self._maybe_retire(slot)

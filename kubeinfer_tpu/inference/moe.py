@@ -123,9 +123,6 @@ def moe_block_ep(
 
 
 def make_ep_mesh(ep: int) -> Mesh:
-    import numpy as np
+    from kubeinfer_tpu.inference.sharding import make_axis_mesh
 
-    devices = jax.devices()
-    if ep > len(devices):
-        raise ValueError(f"ep={ep} needs {ep} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:ep]).reshape(ep), axis_names=("ep",))
+    return make_axis_mesh("ep", ep)

@@ -95,15 +95,6 @@ def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
         def embed(mb):
             return other["embed_tokens"][mb]
 
-        def head(x):
-            x = rms_norm(x, other["norm"], cfg.rms_norm_eps)
-            h = (
-                other["embed_tokens"].T
-                if cfg.tie_word_embeddings
-                else other["lm_head"]
-            )
-            return (x @ h).astype(jnp.float32)
-
         mbs = toks.reshape(M, B // M, T)
         H = cfg.hidden_size
         perm_fwd = [(i, (i + 1) % PP) for i in range(PP)]
@@ -115,13 +106,17 @@ def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
             jnp.zeros((B // M, T, H), other["norm"].dtype),
             ("pp",), to="varying",
         )  # inbound activation from the previous stage
-        outputs = lax.pcast(
-            jnp.zeros((M, B // M, T, cfg.vocab_size), jnp.float32),
+        # carry ACTIVATIONS, not logits: a vocab-sized carry would be
+        # ~16-32x bigger for real models, and projecting per tick would
+        # run the model's largest matmul PP*(M+PP-1) times instead of
+        # once post-scan
+        acts = lax.pcast(
+            jnp.zeros((M, B // M, T, H), other["norm"].dtype),
             ("pp",), to="varying",
         )
 
         def tick(carry, t):
-            buf, outputs = carry
+            buf, acts = carry
             # stage 0 injects microbatch t (if still filling)
             x_in = jnp.where(
                 (p == 0) & (t < M),
@@ -129,24 +124,31 @@ def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
                 buf,
             )
             x_out = run_stage(x_in)
-            # last stage emits microbatch (t - PP + 1) when valid
+            # last stage records microbatch (t - PP + 1) when valid
             emit_idx = t - (PP - 1)
-            logits = head(x_out)
-            outputs = jnp.where(
+            acts = jnp.where(
                 (p == PP - 1) & (emit_idx >= 0),
-                outputs.at[jnp.clip(emit_idx, 0, M - 1)].set(logits),
-                outputs,
+                acts.at[jnp.clip(emit_idx, 0, M - 1)].set(x_out),
+                acts,
             )
             buf = lax.ppermute(x_out, "pp", perm_fwd)
-            return (buf, outputs), ()
+            return (buf, acts), ()
 
-        (buf, outputs), _ = lax.scan(
-            tick, (buf, outputs), jnp.arange(M + PP - 1)
+        (buf, acts), _ = lax.scan(
+            tick, (buf, acts), jnp.arange(M + PP - 1)
         )
-        # only the last stage holds real logits; broadcast to all
-        outputs = jnp.where(p == PP - 1, outputs, 0.0)
-        outputs = lax.psum(outputs, "pp")
-        return outputs.reshape(B, T, cfg.vocab_size)
+        # only the last stage holds real activations; broadcast, then
+        # norm + head ONCE over the full batch
+        acts = lax.psum(jnp.where(p == PP - 1, acts, 0.0), "pp")
+        x = rms_norm(
+            acts.reshape(B, T, H), other["norm"], cfg.rms_norm_eps
+        )
+        h = (
+            other["embed_tokens"].T
+            if cfg.tie_word_embeddings
+            else other["lm_head"]
+        )
+        return (x @ h).astype(jnp.float32)
 
     return jax.jit(
         jax.shard_map(
@@ -185,9 +187,6 @@ def pipeline_forward(
 
 
 def make_pp_mesh(pp: int) -> Mesh:
-    import numpy as np
+    from kubeinfer_tpu.inference.sharding import make_axis_mesh
 
-    devices = jax.devices()
-    if pp > len(devices):
-        raise ValueError(f"pp={pp} needs {pp} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:pp]).reshape(pp), axis_names=("pp",))
+    return make_axis_mesh("pp", pp)

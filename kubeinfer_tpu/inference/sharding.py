@@ -49,6 +49,20 @@ def make_inference_mesh(
     )
 
 
+def make_axis_mesh(axis_name: str, n: int) -> Mesh:
+    """1-D mesh over the first ``n`` devices (shared by the pp/ep
+    constructors — one place for device-count checks and, later, any
+    ICI-locality device ordering)."""
+    import numpy as np
+
+    devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(
+            f"{axis_name}={n} needs {n} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:n]).reshape(n), axis_names=(axis_name,))
+
+
 def param_specs(cfg: ModelConfig) -> Params:
     """PartitionSpec tree matching init_params' layout (Megatron TP)."""
     layer = {

@@ -135,10 +135,15 @@ class Manager:
             self.store_server = None
             self.store = RemoteStore(cfg.store_connect, token=cfg.auth_token)
         else:
+            from kubeinfer_tpu.scheduler.backends import solve_service_handler
+
             self._local_store = Store()
             self.store_server = StoreServer(
                 self._local_store, cfg.store_bind_host, cfg.store_bind_port,
                 token=cfg.auth_token,
+                # POST /solve: the scheduler as an RPC for external
+                # controllers (SURVEY §7 step 3 boundary)
+                solve_handler=solve_service_handler,
             )
             # The in-process controller bypasses HTTP (same truth, no hop).
             self.store = self._local_store

@@ -289,6 +289,58 @@ class JaxBackend(SchedulerBackend):
         )
 
 
+def solve_service_handler(body: dict) -> dict:
+    """JSON solve RPC (the /solve endpoint's business logic).
+
+    Request: ``{"policy": "...", "jobs": {gpu, memGib, priority?, gang?,
+    model?, currentNode?}, "nodes": {gpuFree, memFreeGib, gpuCapacity?,
+    memCapacityGib?, topology?}}`` — arrays as JSON lists, one entry per
+    replica/node. Response: assignment + diagnostics. External
+    controllers get placements without embedding JAX; the manager's own
+    reconciler keeps the in-process fast path.
+    """
+    if not isinstance(body, dict):
+        raise ValueError("body must be a JSON object")
+    jobs = body.get("jobs") or {}
+    nodes = body.get("nodes") or {}
+    if not isinstance(jobs, dict) or not isinstance(nodes, dict):
+        raise ValueError("jobs and nodes must be JSON objects")
+    if "gpu" not in jobs or "gpuFree" not in nodes:
+        raise ValueError("body needs jobs.gpu and nodes.gpuFree arrays")
+
+    def arr(v, dtype, default=None):
+        if v is None:
+            return default
+        return np.asarray(v, dtype)
+
+    J, N = len(jobs["gpu"]), len(nodes["gpuFree"])
+    req = SolveRequest(
+        job_gpu=np.asarray(jobs["gpu"], np.float32),
+        job_mem_gib=arr(
+            jobs.get("memGib"), np.float32, np.zeros(J, np.float32)
+        ),
+        job_priority=arr(jobs.get("priority"), np.float32),
+        job_gang=arr(jobs.get("gang"), np.int32),
+        job_model=arr(jobs.get("model"), np.int32),
+        job_current_node=arr(jobs.get("currentNode"), np.int32),
+        node_gpu_free=np.asarray(nodes["gpuFree"], np.float32),
+        node_mem_free_gib=arr(
+            nodes.get("memFreeGib"), np.float32, np.zeros(N, np.float32)
+        ),
+        node_gpu_capacity=arr(nodes.get("gpuCapacity"), np.float32),
+        node_mem_capacity_gib=arr(nodes.get("memCapacityGib"), np.float32),
+        node_topology=arr(nodes.get("topology"), np.int32),
+    )
+    res = get_backend(body.get("policy", "jax-greedy")).solve(req)
+    return {
+        "assignment": res.assignment.tolist(),
+        "placed": int(res.placed),
+        "solveMs": round(res.solve_ms, 3),
+        "policy": res.policy,
+        "rounds": res.rounds,
+    }
+
+
 _BACKENDS: dict[str, SchedulerBackend] = {}
 
 

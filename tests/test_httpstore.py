@@ -176,3 +176,49 @@ class TestAuth:
             assert anon.healthz()
         finally:
             server.shutdown()
+
+
+class TestSolveService:
+    """POST /solve: the scheduler as an RPC (SURVEY §7 step 3)."""
+
+    def test_solve_roundtrip(self):
+        from kubeinfer_tpu.scheduler.backends import solve_service_handler
+
+        store = Store()
+        server = StoreServer(
+            store, port=0, solve_handler=solve_service_handler
+        ).start()
+        try:
+            remote = RemoteStore(server.address)
+            resp = remote._req("POST", "/solve", {
+                "policy": "jax-greedy",
+                "jobs": {"gpu": [2, 4, 1, 8], "memGib": [8, 16, 4, 32]},
+                "nodes": {"gpuFree": [8, 8], "memFreeGib": [64, 64]},
+            })
+            assert resp["placed"] == 4
+            assert len(resp["assignment"]) == 4
+            assert all(a in (0, 1) for a in resp["assignment"])
+            assert resp["policy"] == "jax-greedy"
+        finally:
+            server.shutdown()
+
+    def test_solve_validates_body(self):
+        from kubeinfer_tpu.api.types import ValidationError
+        from kubeinfer_tpu.scheduler.backends import solve_service_handler
+
+        store = Store()
+        server = StoreServer(
+            store, port=0, solve_handler=solve_service_handler
+        ).start()
+        try:
+            remote = RemoteStore(server.address)
+            with pytest.raises(ValidationError):
+                remote._req("POST", "/solve", {"jobs": {}})
+        finally:
+            server.shutdown()
+
+    def test_solve_absent_without_handler(self, served_store):
+        _, remote = served_store
+        with pytest.raises(NotFoundError):
+            remote._req("POST", "/solve", {"jobs": {"gpu": [1]},
+                                           "nodes": {"gpuFree": [1]}})
