@@ -48,11 +48,14 @@ class SlotState:
     last_token: jax.Array  # i32[B]
     offset: jax.Array  # i32[B] next cache position (= current length)
     active: jax.Array  # bool[B]
+    temperature: jax.Array  # f32[B]; <=0 = greedy
+    rng: jax.Array  # u32[B, 2] per-slot PRNG key data
 
 
 jax.tree_util.register_dataclass(
     SlotState,
-    data_fields=["caches_k", "caches_v", "last_token", "offset", "active"],
+    data_fields=["caches_k", "caches_v", "last_token", "offset", "active",
+                 "temperature", "rng"],
     meta_fields=[],
 )
 
@@ -66,7 +69,26 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
         last_token=jnp.zeros((n_slots,), jnp.int32),
         offset=jnp.zeros((n_slots,), jnp.int32),
         active=jnp.zeros((n_slots,), bool),
+        temperature=jnp.zeros((n_slots,), jnp.float32),
+        rng=jnp.zeros((n_slots, 2), jnp.uint32),
     )
+
+
+def _sample_rows(
+    logits: jax.Array,  # f32[B, V]
+    temperature: jax.Array,  # f32[B]
+    rng: jax.Array,  # u32[B, 2]
+    counter: jax.Array,  # i32[B] — folded in so each step draws fresh noise
+) -> jax.Array:
+    from kubeinfer_tpu.inference.engine import gumbel_sample
+
+    def sample_one(row_logits, key_data, ctr, temp):
+        key = jax.random.fold_in(
+            jax.random.wrap_key_data(key_data, impl="threefry2x32"), ctr
+        )
+        return gumbel_sample(row_logits, key, temp)
+
+    return jax.vmap(sample_one)(logits, rng, counter, temperature)
 
 
 @functools.partial(
@@ -75,7 +97,7 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
 def _decode_step(
     params: Params, state: SlotState, cfg: ModelConfig
 ) -> tuple[SlotState, jax.Array]:
-    """One greedy token for every active slot; returns (state, tokens).
+    """One token for every active slot (greedy, or per-slot temperature\n    sampling keyed by the slot PRNG + offset); returns (state, tokens).
 
     Inactive slots still flow through the math (static shapes) but their
     cache/offset/token state is preserved unchanged.
@@ -98,7 +120,12 @@ def _decode_step(
     )
     new_k = [c[0] for c in caches]
     new_v = [c[1] for c in caches]
-    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    # counter offset+1: admit folds prompt_len (== first decode offset),
+    # so folding the bare offset here would reuse the admit-time gumbel
+    # draw and systematically double the first sampled token
+    nxt = _sample_rows(
+        logits[:, 0], state.temperature, state.rng, state.offset + 1
+    )
 
     keep = state.active
     new_state = SlotState(
@@ -113,6 +140,8 @@ def _decode_step(
         last_token=jnp.where(keep, nxt, state.last_token),
         offset=jnp.where(keep, state.offset + 1, state.offset),
         active=state.active,
+        temperature=state.temperature,
+        rng=state.rng,
     )
     return new_state, jnp.where(keep, nxt, -1)
 
@@ -125,6 +154,8 @@ def _admit_slot(
     prompt_len: jax.Array,  # i32[]
     cfg: ModelConfig,
     slot: jax.Array,  # i32[] — traced, or admission compiles per slot
+    temperature: jax.Array,  # f32[]
+    key_data: jax.Array,  # u32[2] per-request PRNG key data
 ) -> SlotState:
     """Prefill one request into slot ``slot`` (compiled per T bucket)."""
     T = prompt.shape[1]
@@ -148,7 +179,10 @@ def _admit_slot(
         params, prompt, cfg, attn_mask=mask, kv_caches=caches, cache_offset=0
     )
     last = jnp.clip(prompt_len - 1, 0, T - 1)
-    first = jnp.argmax(logits[0, last], axis=-1).astype(jnp.int32)
+    first = _sample_rows(
+        logits[:, last], temperature[None], key_data[None],
+        prompt_len[None],
+    )[0]
 
     def put(big, small):
         return jax.lax.dynamic_update_slice(
@@ -161,6 +195,8 @@ def _admit_slot(
         last_token=state.last_token.at[slot].set(first),
         offset=state.offset.at[slot].set(prompt_len),
         active=state.active.at[slot].set(True),
+        temperature=state.temperature.at[slot].set(temperature),
+        rng=state.rng.at[slot].set(key_data),
     )
 
 
@@ -172,6 +208,8 @@ class _Request:
     prompt: list[int]
     max_new: int
     eos_id: int
+    temperature: float = 0.0
+    seed: int = 0
     out_tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: threading.Event = field(default_factory=threading.Event)
@@ -221,7 +259,8 @@ class ContinuousEngine:
         )
 
     def submit(self, prompt: list[int], max_new_tokens: int = 32,
-               eos_id: int = -1) -> _Request:
+               eos_id: int = -1, temperature: float = 0.0,
+               seed: int = 0) -> _Request:
         if not prompt:
             raise ValueError("empty prompt")
         if not self.fits(len(prompt), max_new_tokens):
@@ -233,13 +272,16 @@ class ContinuousEngine:
                 f"prefill bucket {_bucket(len(prompt))}) exceeds slot "
                 f"capacity ({self.cache_len})"
             )
-        req = _Request(prompt, max_new_tokens, eos_id)
+        req = _Request(prompt, max_new_tokens, eos_id,
+                       temperature=temperature, seed=seed)
         self._queue.put(req)
         return req
 
     def generate(self, prompt: list[int], max_new_tokens: int = 32,
-                 eos_id: int = -1, timeout: float = 300.0) -> list[int]:
-        req = self.submit(prompt, max_new_tokens, eos_id)
+                 eos_id: int = -1, temperature: float = 0.0,
+                 seed: int = 0, timeout: float = 300.0) -> list[int]:
+        req = self.submit(prompt, max_new_tokens, eos_id,
+                          temperature=temperature, seed=seed)
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
@@ -282,9 +324,13 @@ class ContinuousEngine:
         T = _bucket(len(req.prompt))  # submit() guarantees T <= cache_len
         padded = np.zeros((1, T), np.int32)
         padded[0, : len(req.prompt)] = req.prompt
+        key_data = jax.random.key_data(
+            jax.random.PRNGKey(req.seed)
+        ).astype(jnp.uint32)
         self._state = _admit_slot(
             self.params, self._state, jnp.asarray(padded),
             jnp.int32(len(req.prompt)), self.cfg, jnp.int32(slot),
+            jnp.float32(req.temperature), key_data,
         )
         self._slot_req[slot] = req
         # the prefill already produced the first generated token
@@ -312,6 +358,8 @@ class ContinuousEngine:
                 last_token=self._state.last_token,
                 offset=self._state.offset,
                 active=self._state.active.at[slot].set(False),
+                temperature=self._state.temperature,
+                rng=self._state.rng,
             )
             req.done.set()
 

@@ -34,6 +34,10 @@ PROMPT_BUCKETS = (
     16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768,
     65536, 131072,  # long-context models advertise up to 128k positions
 )
+# Prefill processes the prompt in chunks of this many tokens (peak
+# attention memory O(chunk * cache_len), not O(T^2)); every bucket > 512
+# is a multiple of it.
+PREFILL_CHUNK = 512
 
 
 def _bucket(n: int) -> int:
@@ -49,8 +53,24 @@ class GenerationResult:
     lengths: np.ndarray  # i32[B] generated length per sequence
 
 
+def gumbel_sample(
+    logits: jax.Array, key: jax.Array, temperature: jax.Array
+) -> jax.Array:
+    """Temperature sampling via the gumbel trick; temperature <= 0 means
+    greedy. ONE home for the sampling math — the per-request engine and
+    the continuous batcher must sample identically for the same params.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    g = jax.random.gumbel(key, logits.shape, jnp.float32)
+    sampled = jnp.argmax(
+        logits / jnp.maximum(temperature, 1e-6) + g, axis=-1
+    ).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new", "cache_len")
+    jax.jit,
+    static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
 )
 def _generate_jit(
     params: Params,
@@ -59,6 +79,7 @@ def _generate_jit(
     cfg: ModelConfig,
     max_new: int,
     cache_len: int,
+    prefill_chunk: int,
     eos_id: jax.Array,  # i32 (negative = never stop)
     temperature: jax.Array,  # f32; <=0 = greedy
     rng_key: jax.Array,
@@ -73,31 +94,49 @@ def _generate_jit(
         for _ in range(cfg.num_hidden_layers)
     ]
 
-    # --- prefill: causal over the bucket, pad rows masked out -----------
-    pos = jnp.arange(T)
-    valid = pos[None, :] < prompt_len[:, None]  # [B, T]
-    mask = (pos[None, None, :] <= pos[None, :, None]) & valid[:, None, :]
-    mask = jnp.broadcast_to(mask, (B, T, T))
-    mask = jnp.concatenate(
-        [mask, jnp.zeros((B, T, cache_len - T), bool)], axis=2
-    )
-    logits, caches = forward(
-        params, prompt, cfg, attn_mask=mask, kv_caches=caches, cache_offset=0
-    )
-    # next-token logits come from the LAST REAL prompt position per row
+    # --- prefill: chunked so long prompts never materialize [T, T] ------
+    # Each chunk of C tokens attends causally against the cache (a
+    # [C, cache_len] mask), so peak attention memory is O(C * S) instead
+    # of O(T^2) — the difference between a 128k-token prompt fitting in
+    # HBM or not. The chunk loop is a scan (one trace regardless of
+    # chunk count; 131072/512 unrolled copies would blow up compile).
+    C = min(T, prefill_chunk)
+    pos = jnp.arange(cache_len)
     last = jnp.clip(prompt_len - 1, 0, T - 1)
-    next_logits = jnp.take_along_axis(
-        logits, last[:, None, None], axis=1
-    )[:, 0]  # [B, V]
+
+    def prefill_step(carry, c0):
+        caches, next_logits = carry
+        chunk = jax.lax.dynamic_slice(prompt, (0, c0), (B, C))
+        q_pos = c0 + jnp.arange(C)
+        # attend to cache positions <= own position, and only to real
+        # (non-pad) prompt positions
+        mask = (
+            (pos[None, None, :] <= q_pos[None, :, None])
+            & (pos[None, None, :] < prompt_len[:, None, None])
+        )
+        mask = jnp.broadcast_to(mask, (B, C, cache_len))
+        logits, caches = forward(
+            params, chunk, cfg, attn_mask=mask, kv_caches=caches,
+            cache_offset=c0,
+        )
+        # the row's next-token logits live in whichever chunk holds its
+        # LAST REAL prompt position
+        in_chunk = (last >= c0) & (last < c0 + C)
+        idx = jnp.clip(last - c0, 0, C - 1)
+        chunk_last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1
+        )[:, 0]
+        next_logits = jnp.where(in_chunk[:, None], chunk_last, next_logits)
+        return (caches, next_logits), ()
+
+    (caches, next_logits), _ = jax.lax.scan(
+        prefill_step,
+        (caches, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
+        jnp.arange(0, T, C),
+    )
 
     def sample(logits, key):
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        g = jax.random.gumbel(key, logits.shape, jnp.float32)
-        temp = jnp.maximum(temperature, 1e-6)
-        sampled = jnp.argmax(
-            logits / temp + g, axis=-1
-        ).astype(jnp.int32)
-        return jnp.where(temperature > 0, sampled, greedy)
+        return gumbel_sample(logits, key, temperature)
 
     k0, krest = jax.random.split(rng_key)
     first = sample(next_logits, k0)
@@ -203,6 +242,7 @@ class Engine:
                 self.cfg,
                 max_new_tokens,
                 cache_len,
+                PREFILL_CHUNK,
                 jnp.int32(eos_id),
                 jnp.float32(temperature),
                 # fold the group length in: identical keys across length

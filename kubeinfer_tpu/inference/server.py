@@ -132,16 +132,17 @@ class InferenceServer:
 
         if (
             self.continuous is not None
-            and temperature <= 0
             and self.continuous.fits(len(ids), max_tokens)
         ):
-            # greedy requests ride the shared continuous-batching slots:
-            # concurrent clients decode together instead of serializing.
-            # Requests beyond slot width (long context) fall through to
-            # the per-request engine, which serves the model's full
-            # context.
+            # requests ride the shared continuous-batching slots (greedy
+            # and sampled alike — slots carry per-request temperature and
+            # PRNG state): concurrent clients decode together instead of
+            # serializing. Requests beyond slot width (long context) fall
+            # through to the per-request engine, which serves the model's
+            # full context.
             gen = self.continuous.generate(
-                ids, max_new_tokens=max_tokens, eos_id=eos_id
+                ids, max_new_tokens=max_tokens, eos_id=eos_id,
+                temperature=temperature, seed=seed,
             )
         else:
             out = self.engine.generate(
@@ -213,8 +214,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="serve a randomly initialized --model preset "
                         "(demo/e2e mode; no weights needed)")
     p.add_argument("--batch-slots", type=int, default=8,
-                   help="continuous-batching decode slots for greedy "
-                        "requests (0 disables)")
+                   help="continuous-batching decode slots shared by "
+                        "concurrent requests, greedy and sampled alike "
+                        "(0 disables; over-slot-width requests use the "
+                        "per-request engine)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 

@@ -53,6 +53,18 @@ class TestEngine:
         assert out.lengths[0] == 3
         assert (out.tokens[0, 3:] == eos).all()  # post-EOS padded with EOS
 
+    def test_chunked_prefill_multi_chunk_exact(self, monkeypatch):
+        """Prefill split across several chunks must equal the one-shot
+        forward (patch the chunk small so test-sized prompts span >1)."""
+        import kubeinfer_tpu.inference.engine as eng
+
+        monkeypatch.setattr(eng, "PREFILL_CHUNK", 8)
+        params = init_params(TINY, jax.random.PRNGKey(4))
+        engine = Engine(params, TINY)
+        prompt = list(np.random.default_rng(13).integers(1, 200, 27))
+        out = engine.generate([prompt], max_new_tokens=5)
+        assert out.tokens[0].tolist() == ref_greedy(params, prompt, 5)
+
     def test_single_new_token(self):
         # regression: max_new_tokens=1 used to feed lax.scan a 1-key xs
         # with length=0 and assert out
