@@ -272,10 +272,14 @@ def _ensure_backend_alive(timeout_s: float = 180.0) -> None:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["_KUBEINFER_BENCH_CPU_FALLBACK"] = "1"
-    # drop any sitecustomize that imports jax against the relay at startup
+    # drop any sitecustomize that imports jax against the relay at
+    # startup (match a path COMPONENT, not a substring — a path merely
+    # containing "axon" must survive)
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p
+        if p and not any(
+            seg in (".axon_site", "axon") for seg in p.split(os.sep)
+        )
     )
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
@@ -287,9 +291,17 @@ def main() -> None:
     ap.add_argument("--full", action="store_true",
                     help="(kept for compat; the sweep now runs by default)")
     args = ap.parse_args()
-    reps = 5 if args.quick else 20
 
     _ensure_backend_alive()
+    import os
+
+    if os.environ.get("_KUBEINFER_BENCH_CPU_FALLBACK") == "1":
+        # CPU emergency mode: the full sweep (50k-job soak, 20 reps)
+        # takes tens of minutes on one CPU core — far past any driver
+        # timeout, which would lose the output line entirely. Headline
+        # only, few reps.
+        args.quick = True
+    reps = 5 if args.quick else 20
 
     import jax
 

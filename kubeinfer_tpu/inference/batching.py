@@ -324,8 +324,11 @@ class ContinuousEngine:
         T = _bucket(len(req.prompt))  # submit() guarantees T <= cache_len
         padded = np.zeros((1, T), np.int32)
         padded[0, : len(req.prompt)] = req.prompt
+        # explicit impl: _sample_rows wraps with threefry2x32 and
+        # SlotState.rng is u32[B, 2]; deriving from the default-impl
+        # PRNGKey would break under jax_default_prng_impl=rbg (u32[4])
         key_data = jax.random.key_data(
-            jax.random.PRNGKey(req.seed)
+            jax.random.key(req.seed, impl="threefry2x32")
         ).astype(jnp.uint32)
         self._state = _admit_slot(
             self.params, self._state, jnp.asarray(padded),

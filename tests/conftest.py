@@ -43,6 +43,8 @@ def scrubbed_pythonpath() -> str:
     One copy here so every subprocess-spawning test agrees."""
     rest = [
         p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p
+        if p and not any(
+            seg in (".axon_site", "axon") for seg in p.split(os.sep)
+        )
     ]
     return os.pathsep.join([REPO_ROOT] + rest)

@@ -91,12 +91,19 @@ class TestContinuousBatching:
         # sampled and greedy requests coexist in the same batch
         import threading as th
 
-        results = {}
-        t1 = th.Thread(target=lambda: results.update(
-            g=cont.generate(prompt, 6)))
-        t2 = th.Thread(target=lambda: results.update(
-            s=cont.generate(prompt, 6, temperature=3.0, seed=1)))
-        t1.start(); t2.start(); t1.join(120); t2.join(120)
+        results, errors = {}, {}
+
+        def run(tag, **kw):
+            try:
+                results[tag] = cont.generate(prompt, 6, **kw)
+            except Exception as e:  # surfaced below, not swallowed
+                errors[tag] = e
+
+        t1 = th.Thread(target=run, args=("g",))
+        t2 = th.Thread(target=run, args=("s",),
+                       kwargs=dict(temperature=3.0, seed=1))
+        t1.start(); t2.start(); t1.join(300); t2.join(300)
+        assert not errors, errors
         assert len(results["g"]) == 6 and len(results["s"]) == 6
 
     def test_capacity_rejection(self, engines):
