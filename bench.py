@@ -269,18 +269,13 @@ def _ensure_backend_alive(timeout_s: float = 180.0) -> None:
         f"# accelerator backend unresponsive after {timeout_s:.0f}s; "
         "re-running on CPU", file=sys.stderr,
     )
+    from kubeinfer_tpu.utils.env import scrub_axon_pythonpath
+
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["_KUBEINFER_BENCH_CPU_FALLBACK"] = "1"
-    # drop any sitecustomize that imports jax against the relay at
-    # startup (match a path COMPONENT, not a substring — a path merely
-    # containing "axon" must survive)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and not any(
-            seg in (".axon_site", "axon") for seg in p.split(os.sep)
-        )
-    )
+    # drop any sitecustomize that imports jax against the relay at startup
+    env["PYTHONPATH"] = scrub_axon_pythonpath(env.get("PYTHONPATH", ""))
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 

@@ -1,0 +1,25 @@
+"""Environment helpers shared by subprocess-spawning code."""
+
+from __future__ import annotations
+
+import os
+
+# Path components identifying this dev box's axon sitecustomize (its
+# interpreter-startup jax import dials an experimental remote-TPU relay
+# and can wedge child processes for minutes). Component match, not
+# substring: unrelated paths merely containing "axon" must survive.
+_AXON_COMPONENTS = (".axon_site", "axon")
+
+
+def scrub_axon_pythonpath(pythonpath: str | None = None) -> str:
+    """PYTHONPATH with any axon sitecustomize entries removed.
+
+    One copy of the match rule — bench.py's CPU-fallback re-exec and the
+    test suite's subprocess fixtures must agree on it.
+    """
+    if pythonpath is None:
+        pythonpath = os.environ.get("PYTHONPATH", "")
+    return os.pathsep.join(
+        p for p in pythonpath.split(os.pathsep)
+        if p and not any(seg in _AXON_COMPONENTS for seg in p.split(os.sep))
+    )

@@ -38,13 +38,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def scrubbed_pythonpath() -> str:
     """PYTHONPATH for spawned subprocesses: repo first, this box's axon
-    sitecustomize removed (its interpreter-startup jax import dials an
-    experimental remote-TPU relay and can stall children for minutes).
-    One copy here so every subprocess-spawning test agrees."""
-    rest = [
-        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-        if p and not any(
-            seg in (".axon_site", "axon") for seg in p.split(os.sep)
-        )
-    ]
-    return os.pathsep.join([REPO_ROOT] + rest)
+    sitecustomize removed (kubeinfer_tpu.utils.env owns the match rule;
+    bench.py's CPU fallback uses the same one)."""
+    from kubeinfer_tpu.utils.env import scrub_axon_pythonpath
+
+    rest = scrub_axon_pythonpath()
+    return REPO_ROOT + (os.pathsep + rest if rest else "")
