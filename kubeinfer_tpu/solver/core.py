@@ -526,76 +526,105 @@ def solve_greedy(
 
         accept_reduce = _accept_reduce_jnp
 
-    def cond(state):
-        assigned, gpu_free, mem_free, rounds, progress = state
-        pending = jnp.any((assigned < 0) & jobs.valid)
-        return progress & pending & (rounds < max_rounds)
+    def run_rounds(assigned, gpu_free, mem_free, rounds0, rankf_base,
+                   round_cap):
+        """Greedy rounds to a fixpoint from the given state; jobs whose
+        ``rankf_base`` is RANK_INF may never bid (the fill pass uses this
+        to fence unwound gang members). ``round_cap`` is the absolute
+        round budget for THIS invocation (the fill pass brings its own —
+        sharing the main budget would skip the fill exactly when the
+        main loop exhausts it, the contended regime that needs it most).
+        """
 
-    def body(state):
-        assigned, gpu_free, mem_free, rounds, _ = state
-        # Placed/invalid jobs fold into the fence rank so the round ops
-        # need no separate unassigned input.
-        rankf_eff = jnp.where(assigned < 0, rankf, RANK_INF)
-        u = v_g * gpu_free + v_m * mem_free  # [N] live best-fit pressure
-        minrank = _fence_minrank(
-            gpu_free, mem_free, jobs.gpu_demand, jobs.mem_demand, rankf_eff
-        )
-        prim, alt = round_bids(u, gpu_free, mem_free, rankf_eff, minrank)
-        has1 = prim != BIG
-        choice1 = jnp.where(has1, prim & node_mask, N)
+        def cond(state):
+            assigned, gpu_free, mem_free, rounds, progress = state
+            pending = jnp.any((assigned < 0) & jobs.valid)
+            return progress & pending & (rounds < round_cap)
 
-        accept1, used_g1, used_m1 = _dense_accept(
-            choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
-            gpu_free, mem_free, N, accept_reduce=accept_reduce,
-        )
-        assigned = jnp.where(accept1, choice1, assigned)
-        gpu_free = gpu_free - used_g1
-        mem_free = mem_free - used_m1
+        def body(state):
+            assigned, gpu_free, mem_free, rounds, _ = state
+            # Placed/invalid jobs fold into the fence rank so the round
+            # ops need no separate unassigned input.
+            rankf_eff = jnp.where(assigned < 0, rankf_base, RANK_INF)
+            u = v_g * gpu_free + v_m * mem_free  # [N] live best-fit pressure
+            minrank = _fence_minrank(
+                gpu_free, mem_free, jobs.gpu_demand, jobs.mem_demand,
+                rankf_eff,
+            )
+            prim, alt = round_bids(u, gpu_free, mem_free, rankf_eff, minrank)
+            has1 = prim != BIG
+            choice1 = jnp.where(has1, prim & node_mask, N)
 
-        # Second-chance pass: conflict losers immediately bid their
-        # alternate node against the updated capacities, inside the same
-        # round. Settlement tails (a few hundred losers re-bidding one node
-        # per round) dominated the round count; this halves them for one
-        # extra accept pass of vector ops.
-        # Incumbents whose PRIMARY bid was their home node sit the pass
-        # out: hopping to an alternate the instant home is contested is
-        # exactly the churn the move-hysteresis exists to prevent — they
-        # re-bid next round, and only relocate once home is genuinely
-        # infeasible for them. Together with the home-bid fence exemption
-        # (see ``is_home`` in the bid ops), measured survivor moves under
-        # 10% churn drop from ~7.7% to ~0.2%.
-        home_bid = (jobs.current_node >= 0) & (
-            choice1 == jobs.current_node
-        )
-        retry = has1 & ~accept1 & (alt != BIG) & ~home_bid
-        choice2 = jnp.where(retry, alt & node_mask, N)
-        accept2, used_g2, used_m2 = _dense_accept(
-            choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
-            gpu_free, mem_free, N, accept_reduce=accept_reduce,
-        )
-        assigned = jnp.where(accept2, choice2, assigned)
-        # Progress: any bid implies >=1 accept (a contested node's winner in
-        # the first pass always fits — it bid against these capacities), so
-        # a no-accept round means no unplaced job had a biddable node:
-        # fixpoint.
-        return (
-            assigned,
-            gpu_free - used_g2,
-            mem_free - used_m2,
-            rounds + 1,
-            jnp.any(accept1) | jnp.any(accept2),
+            accept1, used_g1, used_m1 = _dense_accept(
+                choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
+                gpu_free, mem_free, N, accept_reduce=accept_reduce,
+            )
+            assigned = jnp.where(accept1, choice1, assigned)
+            gpu_free = gpu_free - used_g1
+            mem_free = mem_free - used_m1
+
+            # Second-chance pass: conflict losers immediately bid their
+            # alternate node against the updated capacities, inside the
+            # same round. Settlement tails (a few hundred losers
+            # re-bidding one node per round) dominated the round count;
+            # this halves them for one extra accept pass of vector ops.
+            # Incumbents whose PRIMARY bid was their home node sit the
+            # pass out: hopping to an alternate the instant home is
+            # contested is exactly the churn the move-hysteresis exists
+            # to prevent — they re-bid next round, and only relocate once
+            # home is genuinely infeasible for them. Together with the
+            # home-bid fence exemption (see ``is_home`` in the bid ops),
+            # measured survivor moves under 10% churn drop from ~7.7% to
+            # ~0.2%.
+            home_bid = (jobs.current_node >= 0) & (
+                choice1 == jobs.current_node
+            )
+            retry = has1 & ~accept1 & (alt != BIG) & ~home_bid
+            choice2 = jnp.where(retry, alt & node_mask, N)
+            accept2, used_g2, used_m2 = _dense_accept(
+                choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
+                gpu_free, mem_free, N, accept_reduce=accept_reduce,
+            )
+            assigned = jnp.where(accept2, choice2, assigned)
+            # Progress: any bid implies >=1 accept (a contested node's
+            # winner in the first pass always fits — it bid against these
+            # capacities), so a no-accept round means no unplaced job had
+            # a biddable node: fixpoint.
+            return (
+                assigned,
+                gpu_free - used_g2,
+                mem_free - used_m2,
+                rounds + 1,
+                jnp.any(accept1) | jnp.any(accept2),
+            )
+
+        return lax.while_loop(
+            cond, body,
+            (assigned, gpu_free, mem_free, rounds0, jnp.bool_(True)),
         )
 
-    init = (
-        jnp.full((J,), -1, jnp.int32),
-        gf_valid,
-        nodes.mem_free,
-        jnp.int32(0),
-        jnp.bool_(True),
+    assigned, gpu_free, mem_free, rounds, _ = run_rounds(
+        jnp.full((J,), -1, jnp.int32), gf_valid, nodes.mem_free,
+        jnp.int32(0), rankf, jnp.int32(max_rounds),
     )
-    assigned, gpu_free, mem_free, rounds, _ = lax.while_loop(cond, body, init)
 
     assigned, gpu_free, mem_free = _gang_repair(p, assigned)
+    # Fill pass: gang repair RETURNS capacity after the fixpoint, which
+    # can leave feasible non-gang jobs stranded (found by the property
+    # fuzz). Re-run the rounds with every unwound gang member fenced —
+    # only non-gang jobs may claim the freed capacity, so no new repair
+    # is ever needed and the non-gang fixpoint guarantee holds for the
+    # FINAL capacities. Costs one no-progress round when nothing was
+    # freed.
+    rankf_fill = jnp.where(
+        (jobs.gang_id >= 0) & (assigned < 0), RANK_INF, rankf
+    )
+    gf_fill = jnp.where(nodes.valid, gpu_free, -1.0)
+    assigned, gpu_free, mem_free, rounds, _ = run_rounds(
+        assigned, gf_fill, mem_free, rounds, rankf_fill,
+        rounds + jnp.int32(16),
+    )
+    gpu_free = jnp.where(nodes.valid, gpu_free, 0.0)
     placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
     return Assignment(assigned, gpu_free, mem_free, rounds, placed)
 
@@ -643,6 +672,13 @@ def solve_auction(
     benefit cancels out of the bid increments): when preemption matters,
     use ``jax-greedy`` (priority-gated rounds) or ``native-greedy``
     (priority-sorted serial pass).
+
+    Known relaxation: capacity freed by the post-solve gang repair is NOT
+    refilled here (unlike solve_greedy's fill pass) — auction's scope is
+    whole-node one-replica instances where gangs are rare, and the
+    backend guard reroutes multi-replica workloads to greedy; an
+    incomplete gang on this path leaves its nodes idle until the next
+    tick's full re-solve.
     """
     jobs, nodes = p.jobs, p.nodes
     J = jobs.valid.shape[0]
