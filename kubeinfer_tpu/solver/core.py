@@ -34,7 +34,8 @@ Design notes (SURVEY.md §7 hard parts 1-4):
   hysteresis (move-penalty) cost term, so placements are stable unless a
   higher-priority bidder genuinely needs the capacity.
 - Gang all-or-nothing is a post-solve repair: incompletely-placed gangs are
-  unwound and their capacity returned (one segmented reduction).
+  unwound and their capacity returned (broadcast-compare reductions — see
+  ``_gang_repair``), then a fenced fill pass re-offers the freed capacity.
 """
 
 from __future__ import annotations
@@ -125,8 +126,25 @@ def _static_cost_t(p: Problem, w: ScoreWeights) -> jax.Array:
     orientation the round loop (and its Pallas tiles) consumes.
     """
     jobs, nodes = p.jobs, p.nodes
-    # cache affinity: cached[n, model_id[j]] -> [N, J]
-    hit = jnp.take(nodes.cached, jobs.model_id, axis=1)  # [N, J] bool
+    # cache affinity: cached[n, model_id[j]] -> [N, J]. Expressed as a
+    # one-hot matmul on the MXU rather than jnp.take — a [N, J] gather
+    # from the bitmap costs ~0.15ms at 1024x12288 (TPU gathers
+    # serialize) vs ~0.06ms for the [N, M] x [M, J] contraction. Exact:
+    # model_id selects one slot, so each product-sum is 0 or 1 in bf16.
+    n_models = nodes.cached.shape[1]
+    onehot = (
+        jobs.model_id[:, None]
+        == jnp.arange(n_models, dtype=jnp.int32)[None, :]
+    )
+    hit = (
+        jax.lax.dot_general(
+            nodes.cached.astype(jnp.bfloat16),
+            onehot.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        > 0.5
+    )  # [N, J] bool
     cost = w.cache * (1.0 - hit.astype(jnp.float32))
 
     n_idx = jnp.arange(nodes.valid.shape[0], dtype=jnp.int32)
@@ -320,9 +338,6 @@ def _dense_accept(
     J = choice.shape[0]
     idx_bits = max((J - 1).bit_length(), 1)
     idx_mask = jnp.int32((1 << idx_bits) - 1)
-    bid = choice < num_nodes
-    node_of = jnp.clip(choice, 0, num_nodes - 1)
-    j_idx = jnp.arange(J, dtype=jnp.int32)
 
     tot_gpu, tot_mem, win_key = accept_reduce(
         choice, accept_key, gpu_demand, mem_demand, num_nodes
@@ -342,9 +357,22 @@ def _dense_accept(
     used_gpu = jnp.where(fits_all, tot_gpu, jnp.where(fits_win, win_gpu, 0.0))
     used_mem = jnp.where(fits_all, tot_mem, jnp.where(fits_win, win_mem, 0.0))
 
-    accept = bid & (
-        fits_all[node_of]
-        | (fits_win[node_of] & (j_idx == win_j[node_of]))
+    # Gather-free accept flags. The direct form — fits_all[node_of] etc. —
+    # is three [J]-from-[N] gathers per accept pass; TPU lowers those to
+    # serialized dynamic-slice loops (measured ~0.53ms/round at 12288x1024,
+    # 70% of the whole round). One fused [N, J] broadcast-compare + any()
+    # costs ~25us on the VPU instead. Winner identity rides the reduced
+    # key itself: win_key[n] == accept_key[j] iff j won node n (the key
+    # embeds the job index, so it is single-valued per job).
+    n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
+    mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel matches none
+    accept = jnp.any(
+        mine
+        & (
+            fits_all[:, None]
+            | (fits_win[:, None] & (win_key[:, None] == accept_key[None, :]))
+        ),
+        axis=0,
     )
     return accept, used_gpu, used_mem
 
@@ -388,14 +416,25 @@ def solve_greedy(
     # per-node priority fence below. Padded rows sort last (neg_p=+inf) and
     # get the highest ranks, but invalid jobs never bid, so they cannot
     # influence the fence.
+    # Dense rank by comparison counting, not argsort: a [J] f32 sort costs
+    # ~0.56ms at J=12288 on TPU (log^2-depth bitonic stages) plus a scatter
+    # to undo the permutation; two fused [J, J] broadcast-compare
+    # reductions cost ~0.1ms on the VPU and XLA never materializes the
+    # square. first_occ marks one representative per distinct value (the
+    # lowest index), so counting smaller representatives yields the number
+    # of DISTINCT smaller values — exactly the sort+cumsum dense rank.
     neg_p = jnp.where(jobs.valid, -jobs.priority, jnp.inf)
-    order_p = jnp.argsort(neg_p)
-    sorted_p = neg_p[order_p]
-    is_new = jnp.concatenate(
-        [jnp.zeros((1,), bool), sorted_p[1:] > sorted_p[:-1]]
+    j_iota = jnp.arange(J, dtype=jnp.int32)
+    first_occ = ~jnp.any(
+        (neg_p[None, :] == neg_p[:, None]) & (j_iota[None, :] < j_iota[:, None]),
+        axis=1,
     )
-    dense_rank = jnp.cumsum(is_new.astype(jnp.int32))
-    prank = jnp.zeros((J,), jnp.int32).at[order_p].set(dense_rank)
+    prank = jnp.sum(
+        ((neg_p[None, :] < neg_p[:, None]) & first_occ[None, :]).astype(
+            jnp.int32
+        ),
+        axis=1,
+    )
     # The fence uses a class-compressed rank: at full resolution a node is
     # biddable only by its single highest interested priority level, and
     # nodes idle whenever that level's jobs bid elsewhere (measured: 30
@@ -404,12 +443,10 @@ def solve_greedy(
     # contend in the same round; exact order within a node still comes from
     # full-resolution prank in the accept key. Padded rows are excluded
     # from the class count (phantom-class regression, advisor r1).
-    last_valid = jnp.maximum(jnp.sum(jobs.valid.astype(jnp.int32)) - 1, 0)
-    n_classes = dense_rank[last_valid] + 1
+    n_classes = jnp.max(jnp.where(jobs.valid, prank, -1)) + 1
     fence_classes = 4
-    crank = (dense_rank * fence_classes) // jnp.maximum(n_classes, 1)
+    crank = (prank * fence_classes) // jnp.maximum(n_classes, 1)
     crank = jnp.minimum(crank, fence_classes - 1)
-    crank = jnp.zeros((J,), jnp.int32).at[order_p].set(crank)
     rankf = jnp.where(jobs.valid, crank.astype(jnp.float32), RANK_INF)
 
     # Tie-spreading field, sampled ONCE per solve: per-round noise over
@@ -615,14 +652,19 @@ def solve_greedy(
     # only non-gang jobs may claim the freed capacity, so no new repair
     # is ever needed and the non-gang fixpoint guarantee holds for the
     # FINAL capacities. Costs one no-progress round when nothing was
-    # freed.
+    # freed. The budget is one round per fillable job plus one: every
+    # progress round places >=1 job, so the loop reaches its fixpoint
+    # before this cap can bind (a fixed cap would silently re-strand
+    # capacity in the worst case — one freed node contested by more
+    # small jobs than the cap, settling ~1 per round).
     rankf_fill = jnp.where(
         (jobs.gang_id >= 0) & (assigned < 0), RANK_INF, rankf
     )
     gf_fill = jnp.where(nodes.valid, gpu_free, -1.0)
+    fillable = (assigned < 0) & jobs.valid & (jobs.gang_id < 0)
     assigned, gpu_free, mem_free, rounds, _ = run_rounds(
         assigned, gf_fill, mem_free, rounds, rankf_fill,
-        rounds + jnp.int32(16),
+        rounds + jnp.sum(fillable.astype(jnp.int32)) + 1,
     )
     gpu_free = jnp.where(nodes.valid, gpu_free, 0.0)
     placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
@@ -631,27 +673,31 @@ def solve_greedy(
 
 def _gang_repair(p: Problem, assigned: jax.Array):
     """Unwind incompletely-placed gangs (all-or-nothing) and recompute
-    capacity from scratch. Gang ids must lie in [0, J)."""
+    capacity from scratch. Any non-negative gang id works (membership is
+    pure equality against other rows; -1 marks non-gang).
+
+    Scatter-free: segment_sum lowers to scatters, which TPUs serialize
+    (measured ~0.3ms here at 12288 jobs); per-JOB gang membership counts
+    via a fused [J, J] broadcast-compare reduction skip both the scatter
+    and the complete[gid] gather-back, and the capacity recompute is the
+    same [N, J] column reduction the accept path uses.
+    """
     jobs, nodes = p.jobs, p.nodes
-    J = jobs.valid.shape[0]
     N = nodes.valid.shape[0]
     in_gang = (jobs.gang_id >= 0) & jobs.valid
-    gid = jnp.clip(jobs.gang_id, 0, J - 1)
-    need = jax.ops.segment_sum(in_gang.astype(jnp.int32), gid, num_segments=J)
-    got = jax.ops.segment_sum(
-        (in_gang & (assigned >= 0)).astype(jnp.int32), gid, num_segments=J
+    gid = jnp.where(in_gang, jobs.gang_id, -1)
+    same = (gid[None, :] == gid[:, None]) & in_gang[None, :]  # [J, J]
+    need = jnp.sum(same.astype(jnp.int32), axis=1)
+    got = jnp.sum(
+        (same & (assigned >= 0)[None, :]).astype(jnp.int32), axis=1
     )
-    complete = got == need
-    keep = (~in_gang) | complete[gid]
+    keep = (~in_gang) | (got == need)
     assigned = jnp.where(keep, assigned, -1)
 
-    seg = jnp.where(assigned >= 0, assigned, N)
-    used_gpu = jax.ops.segment_sum(
-        jnp.where(assigned >= 0, jobs.gpu_demand, 0.0), seg, num_segments=N + 1
-    )[:N]
-    used_mem = jax.ops.segment_sum(
-        jnp.where(assigned >= 0, jobs.mem_demand, 0.0), seg, num_segments=N + 1
-    )[:N]
+    n_iota = jnp.arange(N, dtype=jnp.int32)
+    placed_on = assigned[None, :] == n_iota[:, None]  # [N, J]; -1 matches none
+    used_gpu = jnp.sum(jnp.where(placed_on, jobs.gpu_demand[None, :], 0.0), axis=1)
+    used_mem = jnp.sum(jnp.where(placed_on, jobs.mem_demand[None, :], 0.0), axis=1)
     return assigned, nodes.gpu_free - used_gpu, nodes.mem_free - used_mem
 
 

@@ -200,6 +200,29 @@ class TestGang:
         assert (np.asarray(a.node)[:2] >= 0).all()
         assert int(a.node[2]) == -1
 
+    def test_fill_pass_drains_contested_freed_node(self):
+        # Worst case for the fill pass: a gang unwind frees ONE big node
+        # while more small jobs contend for it than any fixed round cap —
+        # the node is over-subscribed, so it accepts ~1 bidder per round
+        # and settlement needs ~#jobs rounds. A fixed 16-round fill budget
+        # silently re-stranded capacity here (r2 review finding); the
+        # budget now scales with the fillable-job count.
+        jobs = [JobRow(gpu=40, gang=5), JobRow(gpu=40, gang=5)] + [
+            JobRow(gpu=1) for _ in range(50)
+        ]
+        nodes = [
+            NodeRow(gpu_free=40, mem_free_gib=4096),
+            NodeRow(gpu_free=0, mem_free_gib=4096),
+        ]
+        p, _ = encode_problem(jobs, nodes)
+        a = solve_greedy(p)
+        out = np.asarray(a.node)
+        # gang can't fully place (only one 40-chip node) -> unwound
+        assert (out[:2] == -1).all()
+        # the freed 40 chips must be fully drained by the small jobs
+        assert (out[2:52] >= 0).sum() == 40
+        assert float(np.asarray(a.gpu_free)[0]) == 0.0
+
     def test_gang_capacity_freed_for_others(self):
         # Gang that can't fully place must not strand capacity needed by a
         # feasible singleton... (single solve: singleton placed, gang rows -1)
