@@ -150,7 +150,12 @@ class NativeGreedyBackend(SchedulerBackend):
             node_cached=req.node_cached,
         )
         ms = (time.perf_counter() - t0) * 1e3
-        return SolveResult(assignment, placed, ms, self.name)
+        # encode_ms is 0 by construction, not by omission: the serial
+        # tier has no device, so problem packing is inside solve_ms and
+        # there is no separate host->device encode step to report.
+        return SolveResult(
+            assignment, placed, ms, self.name, extras={"encode_ms": 0.0}
+        )
 
 
 def auction_suitable(req: SolveRequest) -> bool:
