@@ -334,6 +334,12 @@ def main() -> None:
     headline_ms = jax_stats["encode_p50_ms"] + dev_ms
 
     extras = {
+        # The driver's output contract fixes the top-level key names, so
+        # the headline's DEFINITION is declared here: r1 artifacts carried
+        # relay-inclusive end-to-end under the same "value"/"vs_baseline"
+        # keys; r2+ carry pack + device solve (local-attach). Cross-round
+        # tooling must read this field, not assume key stability.
+        "headline_definition": "pack_p50_ms + device_solve_ms (local-attach)",
         "device": str(device),
         "backend_platform": device.platform,
         "pack_p50_ms": round(jax_stats["encode_p50_ms"], 3),

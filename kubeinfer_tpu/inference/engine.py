@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.flash_attention import attention_auto
 from kubeinfer_tpu.inference.model import Params, forward
 
 PROMPT_BUCKETS = (
@@ -115,9 +116,14 @@ def _generate_jit(
             & (pos[None, None, :] < prompt_len[:, None, None])
         )
         mask = jnp.broadcast_to(mask, (B, C, cache_len))
+        # attention_auto: Pallas flash kernel on TPU-aligned shapes
+        # (streams the [C, cache_len] scores through VMEM), dense jnp
+        # elsewhere. Numerically equivalent within dtype tolerance, NOT
+        # bit-identical (online-softmax reorders the summation), so
+        # near-tied greedy decodes may differ across backends.
         logits, caches = forward(
             params, chunk, cfg, attn_mask=mask, kv_caches=caches,
-            cache_offset=c0,
+            cache_offset=c0, attn_fn=attention_auto,
         )
         # the row's next-token logits live in whichever chunk holds its
         # LAST REAL prompt position

@@ -1,0 +1,205 @@
+"""Pallas TPU flash attention for the prefill/training hot op.
+
+The dense path (model.attention) materializes [B, n_kv, G, T, S] f32
+scores through HBM; at long context that is the dominant memory term
+(a 512-token chunk against a 128k cache is 0.5GB of scores per layer at
+B=8, H=32). This kernel streams K/V tiles through VMEM with the online
+softmax recurrence (running rowmax m, normalizer l, accumulator o — the
+same algebra as ring_attention.py's block fold, here over the LOCAL S
+axis instead of a device ring), so the f32 score/probability tensors
+never touch HBM. The caller's bool[B, T, S] mask does still ship to the
+kernel (as int8, head-independent — 4*n_kv*G times smaller than the
+scores it replaces); deriving the engine's causal/ragged mask in-kernel
+from (chunk offset, row lengths) iotas would remove that last
+[T, S]-sized term and is the natural next step if profiles demand it.
+
+Layout: GQA folds the (T, G) axes into MXU rows — q becomes
+[B*n_kv, T*G, D], each S tile is one [T_q*G, D] x [D, S_k] matmul plus
+one [T_q*G, S_k] x [S_k, D] matmul, and the boolean mask (which depends
+on T alone, not G) broadcasts across the G subrows in-register. The S
+grid axis is innermost with the accumulators in VMEM scratch, so state
+stays resident across the sweep (same accumulate-across-grid idiom as
+the solver's accept kernel).
+
+The public entry ``flash_attention`` matches model.attention's signature
+([B, T, H, D] q, [B, S, n_kv, D] k/v, bool[B, T, S] mask) so it plugs
+into ``forward(attn_fn=...)`` unchanged; ``attention_auto`` picks the
+kernel when the backend and shapes allow and falls back to the dense
+jnp path otherwise. Fully-masked rows reproduce the dense path's
+uniform-softmax output exactly (all scores -1e30 -> p == 1 everywhere
+-> o/l is the mean over S), so parity holds even on padding rows.
+
+No reference counterpart: the reference delegates all attention to the
+external vLLM process (SURVEY.md §2, vllm.go:93-112).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeinfer_tpu.inference.model import attention as dense_attention
+
+TILE_T = 256  # query positions per tile (rows = TILE_T * G)
+TILE_S = 512  # key/value positions per tile
+
+
+def _flash_kernel(
+    q_ref,  # [1, TILE_T * G, D] folded (t, g) query rows
+    k_ref,  # [1, TILE_S, D]
+    v_ref,  # [1, TILE_S, D]
+    mask_ref,  # [1, TILE_T, TILE_S] int8 (1 = attend)
+    o_ref,  # [1, TILE_T * G, D] out
+    m_scr,  # f32[TILE_T * G, 1] scratch: running rowmax
+    l_scr,  # f32[TILE_T * G, 1] scratch: running normalizer
+    acc_scr,  # f32[TILE_T * G, D] scratch: running accumulator
+    *,
+    groups: int,
+    scale: float,
+    s_tiles: int,
+):
+    ts = pl.program_id(2)  # innermost: S sweep with resident scratch
+
+    @pl.when(ts == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # [TqG, D]
+    k = k_ref[0]  # [Sk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [TqG, Sk]
+    # Masking as an f32 additive penalty broadcast across the G subrows.
+    # Mosaic cannot relayout i1 vectors ("unsupported shape cast" on a
+    # bool [Tq, 1, Sk] broadcast), so the bool never changes rank: it
+    # converts to f32 first, and the rank changes happen on f32 values.
+    pen = (mask_ref[0].astype(jnp.float32) - 1.0) * 1e30  # 0 attend, -1e30 not
+    tq, sk = pen.shape
+    s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
+        tq * groups, sk
+    )
+
+    m_prev = m_scr[:]  # [TqG, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)  # [TqG, Sk] f32
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TqG, D]
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = m_new
+
+    @pl.when(ts == s_tiles - 1)
+    def _finish():
+        # l == 0 cannot happen (even fully-masked rows accumulate
+        # p == 1 per position); the guard keeps hypothetical S == 0
+        # grids finite.
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, n_heads, D]
+    k: jax.Array,  # [B, S, n_kv, D]
+    v: jax.Array,  # [B, S, n_kv, D]
+    mask: jax.Array,  # bool[B, T, S] True = attend
+    *,
+    tile_t: int = TILE_T,
+    tile_s: int = TILE_S,
+    interpret: bool = False,
+) -> jax.Array:
+    """Exact attention, streamed; requires T % tile_t == S % tile_s == 0.
+
+    Callers wanting automatic fallback for unaligned shapes use
+    ``attention_auto``.
+    """
+    B, T, n_heads, D = q.shape
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_t = min(tile_t, T)
+    tile_s = min(tile_s, S)
+    if T % tile_t or S % tile_s:
+        raise ValueError(
+            f"flash_attention needs T divisible by {tile_t} and S by "
+            f"{tile_s}; got T={T} S={S} (use attention_auto for fallback)"
+        )
+    t_tiles, s_tiles = T // tile_t, S // tile_s
+
+    # fold (B, n_kv) into the grid axis and (T, G) into MXU rows
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4)
+    qf = qf.reshape(B * n_kv, T * G, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    mask8 = mask.astype(jnp.int8)
+
+    kern = functools.partial(
+        _flash_kernel,
+        groups=G,
+        scale=1.0 / float(D) ** 0.5,
+        s_tiles=s_tiles,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=(B * n_kv, t_tiles, s_tiles),
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, tile_t, tile_s),
+                lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv, tq, ts),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, mask8)
+    out = out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, T, n_heads, D)
+
+
+def flash_available(T: int, S: int, D: int) -> bool:
+    """Shapes the kernel handles on the current default backend."""
+    return (
+        jax.default_backend() == "tpu"
+        and T % min(TILE_T, T) == 0
+        and S % min(TILE_S, S) == 0
+        and T >= 8
+        and S >= 128
+        and D % 8 == 0
+    )
+
+
+def attention_auto(q, k, v, mask):
+    """model.attention signature; Pallas kernel when shapes/backend
+    allow, dense jnp otherwise. Drop-in for ``forward(attn_fn=...)``."""
+    if flash_available(q.shape[1], k.shape[1], q.shape[3]):
+        return flash_attention(q, k, v, mask)
+    return dense_attention(q, k, v, mask)

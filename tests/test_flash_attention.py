@@ -1,0 +1,120 @@
+"""Parity tests: Pallas flash attention vs the dense jnp path.
+
+Runs the kernel in interpreter mode (works on the CPU test mesh); the
+real-TPU path is exercised by bench.py's engine benchmark. Parity target:
+model.attention (same inputs -> same outputs within dtype tolerance),
+including GQA grouping, multi-tile accumulation, ragged masks, and
+fully-masked (padding) rows.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from kubeinfer_tpu.inference.flash_attention import (
+    attention_auto,
+    flash_attention,
+)
+from kubeinfer_tpu.inference.model import attention as dense_attention
+
+
+def _rand(key, B, T, S, n_heads, n_kv, D, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, n_heads, D), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (B, S, n_kv, D), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv, (B, S, n_kv, D), jnp.float32).astype(dtype)
+    return q, k, v
+
+
+class TestFlashParity:
+    def _check(self, B, T, S, n_heads, n_kv, D, mask, dtype=jnp.float32,
+               tile_t=8, tile_s=16, atol=2e-5):
+        q, k, v = _rand(jax.random.PRNGKey(0), B, T, S, n_heads, n_kv, D,
+                        dtype)
+        want = dense_attention(q, k, v, mask)
+        got = flash_attention(
+            q, k, v, mask, tile_t=tile_t, tile_s=tile_s, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=atol, rtol=1e-4,
+        )
+
+    def test_causal_multi_tile(self):
+        # 4 query tiles x 4 kv tiles exercises the cross-tile recurrence
+        T = S = 64
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((T, S), bool))[None], (2, T, S)
+        )
+        self._check(2, T, S, 4, 4, 16, mask)
+
+    def test_gqa_groups_fold(self):
+        T, S = 16, 32
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((T, S), bool), k=S - T)[None], (2, T, S)
+        )
+        self._check(2, T, S, 8, 2, 16, mask)
+
+    def test_ragged_cache_mask(self):
+        # prefill-chunk shape: T queries against a longer cache with
+        # per-row valid lengths (the engine's actual mask pattern)
+        B, T, S = 3, 8, 48
+        lens = jnp.asarray([5, 48, 17])
+        pos = jnp.arange(S)
+        q_pos = 40 + jnp.arange(T)  # chunk offset 40
+        mask = (pos[None, None, :] <= q_pos[None, :, None]) & (
+            pos[None, None, :] < lens[:, None, None]
+        )
+        self._check(B, T, S, 4, 2, 8, jnp.broadcast_to(mask, (B, T, S)))
+
+    def test_fully_masked_rows_match_dense(self):
+        # rows with nothing attendable: dense softmax of a constant row
+        # is uniform; flash must reproduce that (p == 1 everywhere)
+        B, T, S = 1, 8, 16
+        mask = jnp.zeros((B, T, S), bool)
+        self._check(B, T, S, 2, 2, 8, mask)
+
+    def test_bf16_inputs(self):
+        T = S = 32
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((T, S), bool))[None], (1, T, S)
+        )
+        self._check(1, T, S, 4, 2, 16, mask, dtype=jnp.bfloat16, atol=2e-2)
+
+    def test_single_tile_equals_multi_tile(self):
+        T = S = 32
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((T, S), bool))[None], (1, T, S)
+        )
+        q, k, v = _rand(jax.random.PRNGKey(1), 1, T, S, 4, 4, 8,
+                        jnp.float32)
+        one = flash_attention(q, k, v, mask, tile_t=32, tile_s=32,
+                              interpret=True)
+        many = flash_attention(q, k, v, mask, tile_t=8, tile_s=8,
+                               interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(one), np.asarray(many), atol=2e-5, rtol=1e-4
+        )
+
+    def test_auto_falls_back_off_tpu(self):
+        # CPU test env: attention_auto must route to the dense path and
+        # still be exact
+        T, S = 8, 16
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((T, S), bool), k=S - T)[None], (1, T, S)
+        )
+        q, k, v = _rand(jax.random.PRNGKey(2), 1, T, S, 2, 2, 8,
+                        jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(attention_auto(q, k, v, mask)),
+            np.asarray(dense_attention(q, k, v, mask)),
+        )
+
+    def test_rejects_unaligned(self):
+        with pytest.raises(ValueError, match="divisible"):
+            q, k, v = _rand(jax.random.PRNGKey(3), 1, 24, 24, 2, 2, 8,
+                            jnp.float32)
+            flash_attention(q, k, v, jnp.ones((1, 24, 24), bool),
+                            tile_t=16, tile_s=16, interpret=True)
