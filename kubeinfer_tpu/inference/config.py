@@ -24,6 +24,11 @@ class ModelConfig:
     rope_theta: float = 10000.0
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
+    # Qwen2-family attention: biases on q/k/v projections (o stays
+    # bias-free — the Qwen2 scheme; published llama checkpoints never
+    # ship attention biases, so the hypothetical llama attention_bias
+    # o-projection bias is deliberately unsupported)
+    qkv_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -40,6 +45,15 @@ class ModelConfig:
     @classmethod
     def from_hf_dict(cls, d: dict[str, Any]) -> "ModelConfig":
         """Build from a HuggingFace config.json dict (llama family)."""
+        # llama-style attention_bias=true also puts a bias on o_proj,
+        # which this runtime does not model; loading such a checkpoint
+        # with that bias silently dropped would corrupt every layer's
+        # attention output, so refuse at config time instead.
+        if d.get("attention_bias", False) and d.get("model_type") != "qwen2":
+            raise ValueError(
+                "attention_bias=true (o_proj bias) is not supported; "
+                "only the Qwen2 q/k/v-bias scheme is implemented"
+            )
         return cls(
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
@@ -53,6 +67,7 @@ class ModelConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             max_position_embeddings=d.get("max_position_embeddings", 4096),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
+            qkv_bias=d.get("model_type") == "qwen2",
         )
 
 
@@ -78,6 +93,18 @@ PRESETS: dict[str, ModelConfig] = {
         num_attention_heads=16,
         num_key_value_heads=8,
         max_position_embeddings=4096,
+    ),
+    "qwen2-7b": ModelConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_hidden_layers=28,
+        num_attention_heads=28,
+        num_key_value_heads=4,
+        rms_norm_eps=1e-6,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        qkv_bias=True,
     ),
     "llama-3-8b": ModelConfig(
         vocab_size=128256,

@@ -47,21 +47,24 @@ def init_params(
     layers = []
     for i in range(cfg.num_hidden_layers):
         ks = jax.random.split(jax.random.fold_in(k_layers, i), 7)
-        layers.append(
-            {
-                "input_layernorm": jnp.ones((H,), dtype),
-                "post_attention_layernorm": jnp.ones((H,), dtype),
-                # weights stored [in, out] (transposed vs torch Linear) so
-                # the forward is x @ W with no per-call transpose
-                "q_proj": dense(ks[0], (H, H)),
-                "k_proj": dense(ks[1], (H, kv_dim)),
-                "v_proj": dense(ks[2], (H, kv_dim)),
-                "o_proj": dense(ks[3], (H, H)),
-                "gate_proj": dense(ks[4], (H, F)),
-                "up_proj": dense(ks[5], (H, F)),
-                "down_proj": dense(ks[6], (F, H)),
-            }
-        )
+        layer = {
+            "input_layernorm": jnp.ones((H,), dtype),
+            "post_attention_layernorm": jnp.ones((H,), dtype),
+            # weights stored [in, out] (transposed vs torch Linear) so
+            # the forward is x @ W with no per-call transpose
+            "q_proj": dense(ks[0], (H, H)),
+            "k_proj": dense(ks[1], (H, kv_dim)),
+            "v_proj": dense(ks[2], (H, kv_dim)),
+            "o_proj": dense(ks[3], (H, H)),
+            "gate_proj": dense(ks[4], (H, F)),
+            "up_proj": dense(ks[5], (H, F)),
+            "down_proj": dense(ks[6], (F, H)),
+        }
+        if cfg.qkv_bias:  # Qwen2 family
+            layer["q_bias"] = jnp.zeros((H,), dtype)
+            layer["k_bias"] = jnp.zeros((kv_dim,), dtype)
+            layer["v_bias"] = jnp.zeros((kv_dim,), dtype)
+        layers.append(layer)
     params: Params = {
         "embed_tokens": dense(k_embed, (V, H)),
         "layers": layers,
@@ -143,9 +146,14 @@ def decoder_layer(
     B, T, H = x.shape
     D = cfg.head_dim
     h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
-    q = (h @ layer["q_proj"]).reshape(B, T, cfg.num_attention_heads, D)
-    k = (h @ layer["k_proj"]).reshape(B, T, cfg.num_key_value_heads, D)
-    v = (h @ layer["v_proj"]).reshape(B, T, cfg.num_key_value_heads, D)
+    q, k, v = h @ layer["q_proj"], h @ layer["k_proj"], h @ layer["v_proj"]
+    if cfg.qkv_bias:  # Qwen2 family; o_proj stays bias-free
+        q = q + layer["q_bias"]
+        k = k + layer["k_bias"]
+        v = v + layer["v_bias"]
+    q = q.reshape(B, T, cfg.num_attention_heads, D)
+    k = k.reshape(B, T, cfg.num_key_value_heads, D)
+    v = v.reshape(B, T, cfg.num_key_value_heads, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 

@@ -76,6 +76,10 @@ def param_specs(cfg: ModelConfig) -> Params:
         "up_proj": P(None, "tp"),
         "down_proj": P("tp", None),
     }
+    if cfg.qkv_bias:  # biases follow their projection's output sharding
+        layer["q_bias"] = P("tp")
+        layer["k_bias"] = P("tp")
+        layer["v_bias"] = P("tp")
     return {
         "embed_tokens": P(None, None),  # replicated (small vs the ffn)
         "layers": [layer] * cfg.num_hidden_layers,

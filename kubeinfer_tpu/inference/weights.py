@@ -50,23 +50,32 @@ def params_from_state_dict(
     layers = []
     for i in range(cfg.num_hidden_layers):
         p = f"layers.{i}"
-        layers.append(
-            {
-                "input_layernorm": jnp.asarray(
-                    get(f"{p}.input_layernorm.weight"), dtype
-                ),
-                "post_attention_layernorm": jnp.asarray(
-                    get(f"{p}.post_attention_layernorm.weight"), dtype
-                ),
-                "q_proj": linear(f"{p}.self_attn.q_proj.weight"),
-                "k_proj": linear(f"{p}.self_attn.k_proj.weight"),
-                "v_proj": linear(f"{p}.self_attn.v_proj.weight"),
-                "o_proj": linear(f"{p}.self_attn.o_proj.weight"),
-                "gate_proj": linear(f"{p}.mlp.gate_proj.weight"),
-                "up_proj": linear(f"{p}.mlp.up_proj.weight"),
-                "down_proj": linear(f"{p}.mlp.down_proj.weight"),
-            }
-        )
+        layer = {
+            "input_layernorm": jnp.asarray(
+                get(f"{p}.input_layernorm.weight"), dtype
+            ),
+            "post_attention_layernorm": jnp.asarray(
+                get(f"{p}.post_attention_layernorm.weight"), dtype
+            ),
+            "q_proj": linear(f"{p}.self_attn.q_proj.weight"),
+            "k_proj": linear(f"{p}.self_attn.k_proj.weight"),
+            "v_proj": linear(f"{p}.self_attn.v_proj.weight"),
+            "o_proj": linear(f"{p}.self_attn.o_proj.weight"),
+            "gate_proj": linear(f"{p}.mlp.gate_proj.weight"),
+            "up_proj": linear(f"{p}.mlp.up_proj.weight"),
+            "down_proj": linear(f"{p}.mlp.down_proj.weight"),
+        }
+        if cfg.qkv_bias:  # Qwen2 family
+            layer["q_bias"] = jnp.asarray(
+                get(f"{p}.self_attn.q_proj.bias"), dtype
+            )
+            layer["k_bias"] = jnp.asarray(
+                get(f"{p}.self_attn.k_proj.bias"), dtype
+            )
+            layer["v_bias"] = jnp.asarray(
+                get(f"{p}.self_attn.v_proj.bias"), dtype
+            )
+        layers.append(layer)
     params: Params = {
         "embed_tokens": jnp.asarray(get("embed_tokens.weight"), dtype),
         "layers": layers,

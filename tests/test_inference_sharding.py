@@ -54,6 +54,37 @@ class TestTensorParallel:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
         )
 
+    def test_tp_with_qkv_bias_matches_single_device(self):
+        # Qwen2-family biases must shard with their projections' output
+        # axis (param_specs' qkv_bias branch) and stay numerically exact
+        from kubeinfer_tpu.inference import ModelConfig
+
+        cfg = ModelConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, qkv_bias=True,
+        )
+        key = jax.random.PRNGKey(3)
+        params = init_params(cfg, key)
+        # nonzero biases, or the test cannot distinguish bias sharding
+        # from no bias at all
+        for layer in params["layers"]:
+            for b in ("q_bias", "k_bias", "v_bias"):
+                key, sub = jax.random.split(key)
+                layer[b] = 0.1 * jax.random.normal(
+                    sub, layer[b].shape, layer[b].dtype
+                )
+        toks = jnp.asarray(
+            np.random.default_rng(5).integers(0, 128, (2, 8)), jnp.int32
+        )
+        ref, _ = forward(params, toks, cfg)
+        mesh = make_inference_mesh(tp=4, sp=1)
+        out = forward_tensor_parallel(params, toks, cfg, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+
 
 class TestRingAttention:
     def test_ring_equals_dense(self):
