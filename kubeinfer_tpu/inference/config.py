@@ -29,6 +29,10 @@ class ModelConfig:
     # ship attention biases, so the hypothetical llama attention_bias
     # o-projection bias is deliberately unsupported)
     qkv_bias: bool = False
+    # Mixtral-family sparse MLP: >0 replaces every dense MLP with a
+    # top-k routed mixture of SwiGLU experts (moe.py)
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -68,6 +72,8 @@ class ModelConfig:
             max_position_embeddings=d.get("max_position_embeddings", 4096),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             qkv_bias=d.get("model_type") == "qwen2",
+            num_local_experts=d.get("num_local_experts", 0),
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
         )
 
 
@@ -105,6 +111,19 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=1000000.0,
         max_position_embeddings=32768,
         qkv_bias=True,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        rms_norm_eps=1e-5,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        num_local_experts=8,
+        num_experts_per_tok=2,
     ),
     "llama-3-8b": ModelConfig(
         vocab_size=128256,

@@ -56,10 +56,18 @@ def init_params(
             "k_proj": dense(ks[1], (H, kv_dim)),
             "v_proj": dense(ks[2], (H, kv_dim)),
             "o_proj": dense(ks[3], (H, H)),
-            "gate_proj": dense(ks[4], (H, F)),
-            "up_proj": dense(ks[5], (H, F)),
-            "down_proj": dense(ks[6], (F, H)),
         }
+        if cfg.num_local_experts > 0:  # Mixtral family: routed MLP
+            from kubeinfer_tpu.inference.moe import init_moe_params
+
+            layer["moe"] = init_moe_params(
+                jax.random.fold_in(ks[4], 1), H, F,
+                cfg.num_local_experts, dtype=dtype,
+            )
+        else:
+            layer["gate_proj"] = dense(ks[4], (H, F))
+            layer["up_proj"] = dense(ks[5], (H, F))
+            layer["down_proj"] = dense(ks[6], (F, H))
         if cfg.qkv_bias:  # Qwen2 family
             layer["q_bias"] = jnp.zeros((H,), dtype)
             layer["k_bias"] = jnp.zeros((kv_dim,), dtype)
@@ -73,6 +81,42 @@ def init_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(k_head, (H, V))
     return params
+
+
+def layer_param_template(cfg: ModelConfig) -> dict:
+    """Structure-only pytree of ONE decoder layer (None leaves).
+
+    The single source of truth for which keys a layer carries per config
+    (dense vs moe mlp, qkv biases); spec builders that cannot afford to
+    materialize real params (pipeline.py's stage specs — a mixtral-8x7b
+    init is tens of GB) tree.map over this instead of hardcoding key
+    lists, which silently breaks when a family adds keys (r2 review
+    finding: pp crashed on moe/bias layers).
+    """
+    layer: dict = {
+        "input_layernorm": None,
+        "post_attention_layernorm": None,
+        "q_proj": None,
+        "k_proj": None,
+        "v_proj": None,
+        "o_proj": None,
+    }
+    if cfg.num_local_experts > 0:
+        layer["moe"] = {
+            "router": None,
+            "gate_proj": None,
+            "up_proj": None,
+            "down_proj": None,
+        }
+    else:
+        layer["gate_proj"] = None
+        layer["up_proj"] = None
+        layer["down_proj"] = None
+    if cfg.qkv_bias:
+        layer["q_bias"] = None
+        layer["k_bias"] = None
+        layer["v_bias"] = None
+    return layer
 
 
 # --- building blocks -------------------------------------------------------
@@ -181,8 +225,13 @@ def decoder_layer(
     x = x + attn.reshape(B, T, H) @ layer["o_proj"]
 
     h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(h @ layer["gate_proj"])
-    x = x + (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
+    if "moe" in layer:  # Mixtral family (static: pytree structure)
+        from kubeinfer_tpu.inference.moe import moe_block
+
+        x = x + moe_block(layer["moe"], h, top_k=cfg.num_experts_per_tok)
+    else:
+        gate = jax.nn.silu(h @ layer["gate_proj"])
+        x = x + (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
     return x, kv_cache
 
 

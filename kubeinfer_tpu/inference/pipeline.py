@@ -27,6 +27,7 @@ from kubeinfer_tpu.inference.model import (
     Params,
     causal_mask,
     decoder_layer,
+    layer_param_template,
     rms_norm,
     rope_tables,
 )
@@ -56,15 +57,13 @@ def stack_stage_params(params: Params, n_stages: int) -> Params:
 def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
     """Memoized jitted shard_map per (cfg, mesh, microbatches): building
     it per call would retrace and recompile every forward."""
-    # spec trees built from the fixed param layout (model.init_params)
-    layer_spec = {
-        k: P("pp")
-        for k in (
-            "input_layernorm", "post_attention_layernorm", "q_proj",
-            "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj",
-            "down_proj",
-        )
-    }
+    # spec tree derived from the layer's actual key structure (family-
+    # dependent: dense vs moe mlp, qkv biases) — a hardcoded key list
+    # here broke every non-llama family under pp
+    layer_spec = jax.tree.map(
+        lambda _: P("pp"), layer_param_template(cfg),
+        is_leaf=lambda x: x is None,
+    )
     other_keys = ["embed_tokens", "norm"] + ([] if tied else ["lm_head"])
     other_spec = {k: P() for k in other_keys}
 

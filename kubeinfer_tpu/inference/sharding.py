@@ -72,10 +72,22 @@ def param_specs(cfg: ModelConfig) -> Params:
         "k_proj": P(None, "tp"),
         "v_proj": P(None, "tp"),
         "o_proj": P("tp", None),  # row parallel: psum after
-        "gate_proj": P(None, "tp"),
-        "up_proj": P(None, "tp"),
-        "down_proj": P("tp", None),
     }
+    if cfg.num_local_experts > 0:
+        # Mixtral family under TP: every expert's ffn shards exactly like
+        # the dense mlp (column-parallel gate/up, row-parallel down) with
+        # the expert-stacked leading axis replicated; expert parallelism
+        # over an ``ep`` axis is the separate moe.moe_block_ep path.
+        layer["moe"] = {
+            "router": P(),
+            "gate_proj": P(None, None, "tp"),
+            "up_proj": P(None, None, "tp"),
+            "down_proj": P(None, "tp", None),
+        }
+    else:
+        layer["gate_proj"] = P(None, "tp")
+        layer["up_proj"] = P(None, "tp")
+        layer["down_proj"] = P("tp", None)
     if cfg.qkv_bias:  # biases follow their projection's output sharding
         layer["q_bias"] = P("tp")
         layer["k_bias"] = P("tp")

@@ -61,10 +61,29 @@ def params_from_state_dict(
             "k_proj": linear(f"{p}.self_attn.k_proj.weight"),
             "v_proj": linear(f"{p}.self_attn.v_proj.weight"),
             "o_proj": linear(f"{p}.self_attn.o_proj.weight"),
-            "gate_proj": linear(f"{p}.mlp.gate_proj.weight"),
-            "up_proj": linear(f"{p}.mlp.up_proj.weight"),
-            "down_proj": linear(f"{p}.mlp.down_proj.weight"),
         }
+        if cfg.num_local_experts > 0:
+            # Mixtral naming: block_sparse_moe.gate is the router,
+            # experts.{e}.w1/w3/w2 are gate/up/down; stack experts on a
+            # leading axis (moe.py's [E, ...] layout, sharded over ep)
+            E = cfg.num_local_experts
+            m = f"{p}.block_sparse_moe"
+            layer["moe"] = {
+                "router": linear(f"{m}.gate.weight"),
+                "gate_proj": jnp.stack(
+                    [linear(f"{m}.experts.{e}.w1.weight") for e in range(E)]
+                ),
+                "up_proj": jnp.stack(
+                    [linear(f"{m}.experts.{e}.w3.weight") for e in range(E)]
+                ),
+                "down_proj": jnp.stack(
+                    [linear(f"{m}.experts.{e}.w2.weight") for e in range(E)]
+                ),
+            }
+        else:
+            layer["gate_proj"] = linear(f"{p}.mlp.gate_proj.weight")
+            layer["up_proj"] = linear(f"{p}.mlp.up_proj.weight")
+            layer["down_proj"] = linear(f"{p}.mlp.down_proj.weight")
         if cfg.qkv_bias:  # Qwen2 family
             layer["q_bias"] = jnp.asarray(
                 get(f"{p}.self_attn.q_proj.bias"), dtype

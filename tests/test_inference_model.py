@@ -235,3 +235,85 @@ class TestQwen2Parity:
                     "num_attention_heads": 4,
                 }
             )
+
+
+class TestMixtralParity:
+    """Mixtral family: top-k routed SwiGLU experts replacing the MLP."""
+
+    TINY_MIX = ModelConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=512,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+    )
+
+    @pytest.fixture(scope="class")
+    def hf_mixtral(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        cfg = self.TINY_MIX
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            num_local_experts=cfg.num_local_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        return torch, transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    def test_logits_match_transformers(self, hf_mixtral):
+        # routing parity note: HF softmaxes all logits then renormalizes
+        # the top-k; ours softmaxes the top-k-masked logits — identical
+        # by algebra (the full-softmax denominator cancels)
+        torch, model = hf_mixtral
+        params = params_from_state_dict(
+            model.state_dict(), self.TINY_MIX, dtype=jnp.float32
+        )
+        assert "moe" in params["layers"][0]
+        toks = tokens_for(self.TINY_MIX, B=2, T=12, seed=13)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+        ours, _ = forward(params, jnp.asarray(toks), self.TINY_MIX)
+        np.testing.assert_allclose(
+            np.asarray(ours), ref, rtol=3e-4, atol=3e-4
+        )
+
+    def test_hf_dict_roundtrip(self):
+        cfg = ModelConfig.from_hf_dict(
+            {
+                "model_type": "mixtral",
+                "vocab_size": 256,
+                "hidden_size": 64,
+                "intermediate_size": 96,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "num_local_experts": 4,
+                "num_experts_per_tok": 2,
+            }
+        )
+        assert cfg.num_local_experts == 4 and cfg.num_experts_per_tok == 2
+
+    def test_init_and_generate(self):
+        # init layout matches forward; engine decode works with MoE layers
+        from kubeinfer_tpu.inference.engine import Engine
+
+        params = init_params(self.TINY_MIX, jax.random.PRNGKey(2))
+        engine = Engine(params, self.TINY_MIX)
+        out = engine.generate([[1, 2, 3, 4]], max_new_tokens=3)
+        assert out.tokens.shape == (1, 3)

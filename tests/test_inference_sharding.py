@@ -85,6 +85,29 @@ class TestTensorParallel:
         )
 
 
+    def test_tp_with_moe_matches_single_device(self):
+        # Mixtral-family TP: expert ffns shard like the dense mlp with
+        # the expert axis replicated (param_specs' moe branch)
+        from kubeinfer_tpu.inference import ModelConfig
+
+        cfg = ModelConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2,
+        )
+        params = init_params(cfg, jax.random.PRNGKey(7))
+        toks = jnp.asarray(
+            np.random.default_rng(8).integers(0, 128, (2, 8)), jnp.int32
+        )
+        ref, _ = forward(params, toks, cfg)
+        mesh = make_inference_mesh(tp=4, sp=1)
+        out = forward_tensor_parallel(params, toks, cfg, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+
 
 class TestRingAttention:
     def test_ring_equals_dense(self):
