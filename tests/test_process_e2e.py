@@ -57,6 +57,57 @@ def http_get(url: str, token: str = "") -> tuple[int, str]:
         return 0, ""  # not up yet
 
 
+def start_manager(
+    procs, env, token_file, store_port, metrics_port, health_port, *extra
+):
+    """Spawn the manager process and wait for both probes. One home for
+    the CLI flag set so the e2e tests cannot drift apart."""
+    procs.append(subprocess.Popen(
+        [
+            sys.executable, "-m", "kubeinfer_tpu.manager",
+            "--store-bind-address", f"127.0.0.1:{store_port}",
+            "--metrics-bind-address", f"127.0.0.1:{metrics_port}",
+            "--health-probe-bind-address", f"127.0.0.1:{health_port}",
+            "--auth-token-file", str(token_file),
+            "--tick-interval", "0.2",
+            *extra,
+        ],
+        env=env, cwd=REPO,
+    ))
+    wait_until(
+        lambda: http_get(f"http://127.0.0.1:{health_port}/healthz")[0] == 200,
+        60, "manager /healthz",
+    )
+    wait_until(
+        lambda: http_get(f"http://127.0.0.1:{health_port}/readyz")[0] == 200,
+        60, "manager /readyz",
+    )
+
+
+def ctl_apply(sample, store_addr, token_file, env):
+    apply = subprocess.run(
+        [
+            sys.executable, "-m", "kubeinfer_tpu.ctl",
+            "--store", store_addr, "--token-file", str(token_file),
+            "apply", "-f", sample,
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert apply.returncode == 0, apply.stderr
+    return apply
+
+
+def phase_running(store, name):
+    def running() -> bool:
+        try:
+            svc = store.get("LLMService", name)
+        except (KeyError, OSError):
+            return False
+        return svc["status"]["phase"] == "Running"
+
+    return running
+
+
 @pytest.fixture()
 def subprocess_env(tmp_path):
     from tests.conftest import scrubbed_pythonpath
@@ -79,28 +130,10 @@ def test_manager_agents_cli_end_to_end(tmp_path, subprocess_env):
     store_addr = f"http://127.0.0.1:{store_port}"
     procs: list[subprocess.Popen] = []
     try:
-        manager = subprocess.Popen(
-            [
-                sys.executable, "-m", "kubeinfer_tpu.manager",
-                "--store-bind-address", f"127.0.0.1:{store_port}",
-                "--metrics-bind-address", f"127.0.0.1:{metrics_port}",
-                "--health-probe-bind-address", f"127.0.0.1:{health_port}",
-                "--auth-token-file", str(token_file),
-                "--tick-interval", "0.2",
-                "--node-ttl", "10",
-            ],
-            env=subprocess_env, cwd=REPO,
-        )
-        procs.append(manager)
-
-        # probes come up before the first reconcile finishes
-        wait_until(
-            lambda: http_get(f"http://127.0.0.1:{health_port}/healthz")[0] == 200,
-            60, "manager /healthz",
-        )
-        wait_until(
-            lambda: http_get(f"http://127.0.0.1:{health_port}/readyz")[0] == 200,
-            60, "manager /readyz",
+        start_manager(
+            procs, subprocess_env, token_file,
+            store_port, metrics_port, health_port,
+            "--node-ttl", "10",
         )
 
         for i in range(2):
@@ -127,26 +160,13 @@ def test_manager_agents_cli_end_to_end(tmp_path, subprocess_env):
         wait_until(lambda: len(store.list("Node")) == 2, 60, "2 node heartbeats")
 
         # apply the sample CR through the CLI binary
-        apply = subprocess.run(
-            [
-                sys.executable, "-m", "kubeinfer_tpu.ctl",
-                "--store", store_addr, "--token-file", str(token_file),
-                "apply", "-f", SAMPLE,
-            ],
-            env=subprocess_env, cwd=REPO, capture_output=True, text=True,
-            timeout=60,
-        )
-        assert apply.returncode == 0, apply.stderr
+        apply = ctl_apply(SAMPLE, store_addr, token_file, subprocess_env)
         assert "created" in apply.stdout
 
-        def running() -> bool:
-            try:
-                svc = store.get("LLMService", "llm-cache-demo")
-            except (KeyError, OSError):
-                return False
-            return svc["status"]["phase"] == "Running"
-
-        wait_until(running, 90, "LLMService phase Running")
+        wait_until(
+            phase_running(store, "llm-cache-demo"), 90,
+            "LLMService phase Running",
+        )
 
         svc = store.get("LLMService", "llm-cache-demo")
         assert svc["status"]["availableReplicas"] == 3
@@ -183,6 +203,103 @@ def test_manager_agents_cli_end_to_end(tmp_path, subprocess_env):
         for p in procs:
             assert p.wait(timeout=30) == 0
         procs.clear()
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait(timeout=10)
+
+
+NATIVE_SAMPLE = os.path.join(REPO, "deploy", "samples", "llmservice_native.yaml")
+
+
+def test_native_runtime_end_to_end(tmp_path, subprocess_env):
+    """runtime: native through the full stack: the agent spawns the
+    in-framework JAX engine (`python -m kubeinfer_tpu.inference.server`)
+    as a real subprocess, the replica goes Ready only after the engine's
+    /health, and the served OpenAI-compatible endpoint answers a
+    completion. This is the e2e proof that the scheduler, agent
+    lifecycle, and native inference tier compose.
+    """
+    import json
+
+    token_file = tmp_path / "token"
+    token_file.write_text("e2e-secret\n")
+
+    store_port, metrics_port, health_port = free_port(), free_port(), free_port()
+    serve_port = free_port()
+    store_addr = f"http://127.0.0.1:{store_port}"
+    procs: list[subprocess.Popen] = []
+    try:
+        start_manager(
+            procs, subprocess_env, token_file,
+            store_port, metrics_port, health_port,
+        )
+
+        agent_env = dict(subprocess_env)
+        agent_env.update(
+            NODE_NAME="node-0",
+            STORE_ADDR=store_addr,
+            STORE_TOKEN_FILE=str(token_file),
+            MODEL_PATH=str(tmp_path / "models"),
+            GPU_CAPACITY="8",
+            GPU_MEMORY="16Gi",
+            HEARTBEAT_INTERVAL_S="0.3",
+            KUBEINFER_DOWNLOADER="mock",
+            START_RUNTIMES="1",
+            # engine flags ride the VLLM_* env contract: the random-init
+            # tiny preset needs no checkpoint on disk, and the port must
+            # not collide with other suites on this box
+            VLLM_PORT=str(serve_port),
+            VLLM_EXTRA_ARGS="--random-init",
+            # 1-CPU-core box: first jax compile in the spawned server is
+            # slow; the replica must not go Ready before /health does
+            VLLM_HEALTH_TIMEOUT_S="150",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeinfer_tpu.agent"],
+            env=agent_env, cwd=REPO,
+        ))
+
+        store = RemoteStore(store_addr, token="e2e-secret")
+        wait_until(lambda: len(store.list("Node")) == 1, 60, "node heartbeat")
+
+        ctl_apply(NATIVE_SAMPLE, store_addr, token_file, subprocess_env)
+
+        # generous: the engine subprocess imports jax (slow on one CPU
+        # core) before /health turns 200 and the replica goes Ready
+        wait_until(
+            phase_running(store, "llm-native-demo"), 180,
+            "native LLMService Running",
+        )
+
+        # the engine the agent spawned must actually serve inference.
+        # /health does NOT imply the generate path is compiled — prefill
+        # and the decode scan jit lazily on this first request, so it
+        # carries the compile; budget accordingly (the server's own
+        # internal request timeout is 300s).
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{serve_port}/v1/completions",
+            data=json.dumps(
+                {"prompt": [1, 2, 3, 4], "max_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["choices"], body
+        assert body["usage"]["completion_tokens"] >= 1
+
+        # teardown kills the whole tree, engine subprocess included
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=40) == 0
+        procs.clear()
+        # the serving port must be closed once the agent is gone
+        wait_until(
+            lambda: http_get(f"http://127.0.0.1:{serve_port}/health")[0] == 0,
+            20, "engine port released",
+        )
     finally:
         for p in procs:
             p.kill()
