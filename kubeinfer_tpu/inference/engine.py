@@ -69,38 +69,25 @@ def gumbel_sample(
     return jnp.where(temperature > 0, sampled, greedy)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
-)
-def _generate_jit(
+def chunked_prefill(
     params: Params,
-    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt: jax.Array,  # i32[B, T] left-aligned, 0-padded
     prompt_len: jax.Array,  # i32[B]
     cfg: ModelConfig,
-    max_new: int,
-    cache_len: int,
+    caches,  # per-layer (k, v) fixed-capacity caches
     prefill_chunk: int,
-    eos_id: jax.Array,  # i32 (negative = never stop)
-    temperature: jax.Array,  # f32; <=0 = greedy
-    rng_key: jax.Array,
 ):
-    B, T = prompt.shape
-    D, n_kv = cfg.head_dim, cfg.num_key_value_heads
-    caches = [
-        (
-            jnp.zeros((B, cache_len, n_kv, D), params["norm"].dtype),
-            jnp.zeros((B, cache_len, n_kv, D), params["norm"].dtype),
-        )
-        for _ in range(cfg.num_hidden_layers)
-    ]
+    """Scan the prompt through the model in fixed-size chunks, filling
+    the KV caches; returns (caches, next_logits) where next_logits[b] is
+    the logits at row b's LAST real prompt position.
 
-    # --- prefill: chunked so long prompts never materialize [T, T] ------
-    # Each chunk of C tokens attends causally against the cache (a
-    # [C, cache_len] mask), so peak attention memory is O(C * S) instead
-    # of O(T^2) — the difference between a 128k-token prompt fitting in
-    # HBM or not. The chunk loop is a scan (one trace regardless of
-    # chunk count; 131072/512 unrolled copies would blow up compile).
+    Shared by the per-request engine and the speculative decoder — the
+    chunking (peak attention memory O(chunk * cache_len), one trace for
+    any prompt bucket) and the last-real-position logit selection must
+    behave identically everywhere. Trace-time cost only: callers jit.
+    """
+    B, T = prompt.shape
+    cache_len = caches[0][0].shape[1]
     C = min(T, prefill_chunk)
     pos = jnp.arange(cache_len)
     last = jnp.clip(prompt_len - 1, 0, T - 1)
@@ -139,6 +126,47 @@ def _generate_jit(
         prefill_step,
         (caches, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
         jnp.arange(0, T, C),
+    )
+    return caches, next_logits
+
+
+def make_caches(cfg: ModelConfig, B: int, cache_len: int, dtype):
+    return [
+        (
+            jnp.zeros((B, cache_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+            jnp.zeros((B, cache_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+        )
+        for _ in range(cfg.num_hidden_layers)
+    ]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
+)
+def _generate_jit(
+    params: Params,
+    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    cfg: ModelConfig,
+    max_new: int,
+    cache_len: int,
+    prefill_chunk: int,
+    eos_id: jax.Array,  # i32 (negative = never stop)
+    temperature: jax.Array,  # f32; <=0 = greedy
+    rng_key: jax.Array,
+):
+    B, T = prompt.shape
+    caches = make_caches(cfg, B, cache_len, params["norm"].dtype)
+
+    # --- prefill: chunked so long prompts never materialize [T, T] ------
+    # Each chunk of C tokens attends causally against the cache (a
+    # [C, cache_len] mask), so peak attention memory is O(C * S) instead
+    # of O(T^2) — the difference between a 128k-token prompt fitting in
+    # HBM or not. The chunk loop is a scan (one trace regardless of
+    # chunk count; 131072/512 unrolled copies would blow up compile).
+    caches, next_logits = chunked_prefill(
+        params, prompt, prompt_len, cfg, caches, prefill_chunk
     )
 
     def sample(logits, key):
@@ -189,6 +217,38 @@ def _generate_jit(
     return toks, first_eos.astype(jnp.int32)
 
 
+def prepare_prompts(
+    prompts: list[list[int]],
+    max_new_tokens: int,
+    max_cache_len: int,
+    slack: int = 0,
+):
+    """Host-side prompt prep shared by the engines: validate, bucket,
+    pad, and size the KV cache. ``slack`` is extra cache capacity beyond
+    prompt+new (the speculative decoder writes up to k+1 entries past
+    the frontier). Returns (padded i32[B, T_bucket], lens i32[B],
+    cache_len)."""
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    if lens.min() < 1:
+        raise ValueError("empty prompt")
+    T = _bucket(int(lens.max()))
+    need = int(lens.max()) + max_new_tokens + slack
+    if need > max_cache_len:
+        raise ValueError(
+            f"prompt+new tokens ({need}) exceed the model's context "
+            f"capacity ({max_cache_len})"
+        )
+    # cache width: bucketed for jit-cache reuse, but never below the
+    # prefill bucket T (a cache narrower than the prefill width would
+    # write out of bounds). Bucket rounding may exceed max_cache_len;
+    # positions stay < max_cache_len, extra columns are masked.
+    cache_len = max(T, _bucket(need))
+    padded = np.zeros((len(prompts), T), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, : len(p)] = p
+    return padded, lens, cache_len
+
+
 class Engine:
     """Generation front-end over a loaded model."""
 
@@ -218,24 +278,9 @@ class Engine:
                 np.zeros((0, 0), np.int32), np.zeros((0,), np.int32)
             )
         B = len(prompts)
-        lens = np.asarray([len(p) for p in prompts], np.int32)
-        if lens.min() < 1:
-            raise ValueError("empty prompt")
-        T = _bucket(int(lens.max()))
-        need = int(lens.max()) + max_new_tokens
-        if need > self.max_cache_len:
-            raise ValueError(
-                f"prompt+new tokens ({need}) exceed the model's context "
-                f"capacity ({self.max_cache_len})"
-            )
-        # cache width: bucketed for jit-cache reuse, but never below the
-        # prefill bucket T (a cache narrower than the prefill width would
-        # write out of bounds). Bucket rounding may exceed max_cache_len;
-        # positions stay < max_cache_len, extra columns are masked.
-        cache_len = max(T, _bucket(need))
-        padded = np.zeros((B, T), np.int32)
-        for i, p in enumerate(prompts):
-            padded[i, : len(p)] = p
+        padded, lens, cache_len = prepare_prompts(
+            prompts, max_new_tokens, self.max_cache_len
+        )
 
         toks_out = np.zeros((B, max_new_tokens), np.int32)
         lens_out = np.zeros((B,), np.int32)

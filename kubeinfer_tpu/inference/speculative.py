@@ -1,0 +1,308 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Greedy-only by design: with greedy acceptance (accept a draft token iff
+it equals the target's own argmax at that position) the output is
+**token-identical to vanilla greedy decoding** for ANY draft model — the
+draft only changes how many target forwards the sequence costs, never
+what it says. That identity is the correctness contract
+(tests/test_speculative.py pins it against Engine.generate); sampling-
+based speculative decoding needs the rejection-sampling correction and
+is out of scope.
+
+Static shapes throughout (the jit discipline of engine.py):
+
+- each speculation round runs exactly ``k`` draft steps (T=1 forwards on
+  the draft's KV cache) and ONE target forward over the ``k+1`` window
+  [current token, draft_1..draft_k];
+- acceptance is a prefix-AND reduction; every round emits between 1 and
+  k+1 tokens into a fixed [B, max_new + k + 1] buffer at per-row write
+  offsets (rows advance at different speeds — the per-row cache-offset
+  machinery in model.decoder_layer carries the divergence);
+- rejected cache entries are never erased: the per-row offset simply
+  moves back over them, the position-bounded mask hides them, and the
+  next round's writes overwrite them (the same trick the engine's
+  decode scan uses for its fixed-capacity cache);
+- the round scan runs ``max_new`` times (worst case every round emits
+  just 1 token); finished rows keep stepping with writes masked — the
+  standard static-shape idiom.
+
+Cost model: a round costs 1 target forward of T=k+1 (≈ the cost of a
+T=1 decode step for HBM-bound models — weights dominate) plus k draft
+forwards. With acceptance rate a, expected tokens/round ≈ (1-a^{k+1})/
+(1-a), so a draft ~10x smaller at a ≈ 0.8 and k=4 cuts target forwards
+~3x. No reference counterpart (the reference delegates decoding to
+external vLLM, SURVEY.md §2 #8); design follows the public speculative
+decoding literature (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.engine import (
+    GenerationResult,
+    PREFILL_CHUNK,
+    chunked_prefill,
+    make_caches,
+    prepare_prompts,
+)
+from kubeinfer_tpu.inference.model import Params, forward
+
+
+def _greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
+                     "prefill_chunk"),
+)
+def _spec_generate_jit(
+    params: Params,
+    dparams: Params,
+    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    cfg: ModelConfig,
+    dcfg: ModelConfig,
+    max_new: int,
+    cache_len: int,
+    k: int,
+    prefill_chunk: int,
+    eos_id: jax.Array,  # i32 (negative = never stop)
+):
+    B, T = prompt.shape
+    dtype = params["norm"].dtype
+    tcaches = make_caches(cfg, B, cache_len, dtype)
+    dcaches = make_caches(dcfg, B, cache_len, dparams["norm"].dtype)
+
+    tcaches, t_logits = chunked_prefill(
+        params, prompt, prompt_len, cfg, tcaches, prefill_chunk
+    )
+    dcaches, _ = chunked_prefill(
+        dparams, prompt, prompt_len, dcfg, dcaches, prefill_chunk
+    )
+    first = _greedy(t_logits)  # [B] the target's first generated token
+
+    cache_pos = jnp.arange(cache_len)
+
+    def decode_mask(offsets, q_width):
+        """bool[B, q_width, cache_len]: row b's query at global position
+        offsets[b]+i attends cache slots <= that position (stale slots
+        beyond the valid frontier are excluded by the bound)."""
+        q_pos = offsets[:, None] + jnp.arange(q_width)[None, :]  # [B, W]
+        return cache_pos[None, None, :] <= q_pos[:, :, None]
+
+    def draft_propose(dcaches, prev, cur, offsets):
+        """k greedy draft steps; returns (dcaches, drafts i32[B, k]).
+
+        The FIRST step runs a 2-token window [prev, cur] (positions
+        offsets-1, offsets): after a full-acceptance round the draft
+        cache has a hole at offsets-1 — the bonus token was emitted
+        without the draft ever processing its predecessor — and querying
+        through that hole silently collapses acceptance in every later
+        round (r2 review finding). Rewriting the slot is a no-op for
+        rows without the hole (same token, same cached context, same
+        kv) and repairs it for rows with one.
+        """
+        logits, dcaches = forward(
+            dparams, jnp.stack([prev, cur], axis=1), dcfg,
+            positions=jnp.stack([offsets - 1, offsets], axis=1),
+            attn_mask=decode_mask(offsets - 1, 2),
+            kv_caches=dcaches,
+            cache_offset=offsets - 1,
+        )
+        d1 = _greedy(logits[:, 1])
+
+        def step(carry, i):
+            dcaches, tok, off = carry
+            logits, dcaches = forward(
+                dparams, tok[:, None], dcfg,
+                positions=off[:, None],
+                attn_mask=decode_mask(off, 1),
+                kv_caches=dcaches,
+                cache_offset=off,
+            )
+            nxt = _greedy(logits[:, 0])
+            return (dcaches, nxt, off + 1), nxt
+
+        (dcaches, _, _), rest = jax.lax.scan(
+            step, (dcaches, d1, offsets + 1), jnp.arange(k - 1)
+        )
+        drafts = jnp.concatenate([d1[:, None], rest.swapaxes(0, 1)], axis=1)
+        return dcaches, drafts  # [B, k]
+
+    def round_step(carry, _):
+        (tcaches, dcaches, prev, cur, offsets, written, counts, done,
+         accepted, rounds) = carry
+
+        dcaches, drafts = draft_propose(dcaches, prev, cur, offsets)
+        window = jnp.concatenate([cur[:, None], drafts], axis=1)
+        t_logits, tcaches = forward(
+            params, window, cfg,
+            positions=offsets[:, None] + jnp.arange(k + 1)[None, :],
+            attn_mask=decode_mask(offsets, k + 1),
+            kv_caches=tcaches,
+            cache_offset=offsets,
+        )
+        targets = _greedy(t_logits)
+
+        # longest prefix of drafts the target agrees with
+        agree = drafts == targets[:, :k]
+        prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted draft count, 0..k
+
+        # emitted tokens this round: drafts[:, :m] then targets[:, m] —
+        # a static [B, k+1] row whose slots past m duplicate targets[:, m]
+        # (harmless: n_emit bounds what counts)
+        emit_idx = jnp.arange(k + 1)[None, :]
+        emitted = jnp.where(
+            emit_idx < m[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            jnp.take_along_axis(targets, m[:, None], axis=1),
+        )
+        is_eos = (emitted == eos_id) & (eos_id >= 0)
+        first_eos = jnp.where(
+            is_eos.any(axis=1),
+            jnp.argmax(is_eos, axis=1) + 1,
+            k + 1,
+        )
+        n_emit = jnp.minimum(m + 1, first_eos)
+        n_emit = jnp.where(done, 0, n_emit)
+        hit_eos = is_eos.any(axis=1) & (first_eos <= m + 1)
+
+        # write the static row at each row's count; slots past n_emit are
+        # garbage that the NEXT round's write (which starts inside them)
+        # overwrites, and the host slices to counts at the end. Done rows
+        # write too (at their frozen count, i.e. beyond their final
+        # length) — masking the write would cost a select over the whole
+        # buffer for nothing.
+        written = jax.vmap(
+            lambda buf, row, c: jax.lax.dynamic_update_slice(buf, row, (c,))
+        )(written, emitted, counts)
+
+        counts = counts + n_emit
+        # diagnostics: accepted draft tokens (the speedup) and rounds
+        # with any active row — tests pin sustained acceptance on these
+        accepted = accepted + jnp.maximum(n_emit - 1, 0)
+        rounds = rounds + jnp.any(~done).astype(jnp.int32)
+        done = done | hit_eos | (counts >= max_new)
+        # next round continues from the last VALID token; prev is the
+        # token one position behind it (the draft's repair window)
+        last_idx = jnp.clip(n_emit - 1, 0, k)
+        new_cur = jnp.take_along_axis(
+            emitted, last_idx[:, None], axis=1
+        )[:, 0]
+        prev_idx = jnp.clip(n_emit - 2, 0, k)
+        new_prev = jnp.where(
+            n_emit >= 2,
+            jnp.take_along_axis(emitted, prev_idx[:, None], axis=1)[:, 0],
+            cur,
+        )
+        prev = jnp.where(n_emit > 0, new_prev, prev)
+        cur = jnp.where(n_emit > 0, new_cur, cur)
+        offsets = offsets + n_emit
+        return (
+            (tcaches, dcaches, prev, cur, offsets, written, counts, done,
+             accepted, rounds),
+            (),
+        )
+
+    # round 0 state: the target's first token is emitted before any
+    # speculation (it came from prefill), exactly like engine.py's
+    # ``first``
+    written0 = jnp.zeros((B, max_new + k + 1), jnp.int32)
+    written0 = written0.at[:, 0].set(first)
+    counts0 = jnp.ones((B,), jnp.int32)
+    done0 = (first == eos_id) & (eos_id >= 0)
+    # `first` occupies the cache slot AT each row's prompt length; the
+    # token before it is the prompt's last real token
+    offsets0 = prompt_len
+    prev0 = jnp.take_along_axis(
+        prompt, jnp.clip(prompt_len - 1, 0, T - 1)[:, None], axis=1
+    )[:, 0]
+    state0 = (
+        tcaches, dcaches, prev0, first, offsets0, written0, counts0, done0,
+        jnp.zeros((B,), jnp.int32), jnp.int32(0),
+    )
+
+    if max_new > 1:
+        state, _ = jax.lax.scan(round_step, state0, None, length=max_new - 1)
+    else:
+        state = state0
+    written, counts, accepted, rounds = state[5], state[6], state[8], state[9]
+    return (
+        written[:, : max_new + k + 1],
+        jnp.minimum(counts, max_new),
+        accepted,
+        rounds,
+    )
+
+
+@dataclass
+class SpeculativeEngine:
+    """Greedy generation with draft-model speculation.
+
+    ``generate`` matches Engine.generate's greedy output token-for-token
+    (the acceptance rule guarantees it); ``k`` is the speculation depth.
+    """
+
+    params: Params
+    cfg: ModelConfig
+    draft_params: Params
+    draft_cfg: ModelConfig
+    k: int = 4
+    max_cache_len: int = 0
+
+    def __post_init__(self):
+        if self.cfg.vocab_size != self.draft_cfg.vocab_size:
+            raise ValueError(
+                "draft and target must share a vocabulary "
+                f"({self.draft_cfg.vocab_size} vs {self.cfg.vocab_size})"
+            )
+        if not self.max_cache_len:
+            self.max_cache_len = self.cfg.max_position_embeddings
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 32,
+        eos_id: int = -1,
+    ) -> GenerationResult:
+        if not prompts:
+            return GenerationResult(
+                np.zeros((0, 0), np.int32), np.zeros((0,), np.int32)
+            )
+        B = len(prompts)
+        # slack: every round may write up to k+1 cache entries past the
+        # frontier
+        padded, lens, cache_len = prepare_prompts(
+            prompts, max_new_tokens, self.max_cache_len, slack=self.k + 1
+        )
+
+        toks, counts, accepted, rounds = _spec_generate_jit(
+            self.params, self.draft_params,
+            jnp.asarray(padded), jnp.asarray(lens),
+            self.cfg, self.draft_cfg,
+            max_new_tokens, cache_len, self.k, PREFILL_CHUNK,
+            jnp.int32(eos_id),
+        )
+        # diagnostics for tests/telemetry: accepted draft tokens per row
+        # and speculation rounds executed (the cost side of the trade)
+        self.last_stats = {
+            "accepted_drafts": np.asarray(accepted),
+            "rounds": int(rounds),
+        }
+        toks = np.asarray(toks)[:, :max_new_tokens]
+        counts = np.asarray(counts)
+        # EOS-pad beyond each row's true length (engine.py's contract)
+        out = np.full((B, max_new_tokens), eos_id, np.int32)
+        for b in range(B):
+            out[b, : counts[b]] = toks[b, : counts[b]]
+        return GenerationResult(out, counts)
