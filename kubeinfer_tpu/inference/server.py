@@ -41,9 +41,10 @@ log = logging.getLogger(__name__)
 class InferenceServer:
     def __init__(self, engine, model_id: str, tokenizer=None,
                  host: str = "127.0.0.1", port: int = 8000,
-                 continuous=None) -> None:
+                 continuous=None, speculative=None) -> None:
         self.engine = engine
         self.continuous = continuous  # ContinuousEngine | None
+        self.speculative = speculative  # SpeculativeEngine | None
         self.model_id = model_id
         self.tokenizer = tokenizer
         server = self
@@ -131,6 +132,22 @@ class InferenceServer:
             eos_id = int(self.tokenizer.eos_token_id)
 
         if (
+            self.speculative is not None
+            and temperature <= 0
+            and self.speculative.fits(len(ids), max_tokens)
+        ):
+            # a configured draft model routes GREEDY requests through
+            # speculative decoding (latency over batched throughput —
+            # the operator opted in with --draft-model); speculative
+            # decoding is greedy-only (rejection-sampling correction not
+            # implemented), so sampled requests take the normal paths,
+            # and requests within the target's context but beyond the
+            # k+1 speculation slack fall through rather than fail
+            out = self.speculative.generate(
+                [ids], max_new_tokens=max_tokens, eos_id=eos_id
+            )
+            gen = out.tokens[0, : out.lengths[0]].tolist()
+        elif (
             self.continuous is not None
             and self.continuous.fits(len(ids), max_tokens)
         ):
@@ -218,6 +235,13 @@ def main(argv: list[str] | None = None) -> int:
                         "concurrent requests, greedy and sampled alike "
                         "(0 disables; over-slot-width requests use the "
                         "per-request engine)")
+    p.add_argument("--draft-model", default="",
+                   help="draft model dir (HF snapshot) or preset name "
+                        "(with --random-init) enabling speculative "
+                        "decoding for greedy requests; must share the "
+                        "target's vocabulary")
+    p.add_argument("--speculation-depth", type=int, default=4,
+                   help="draft tokens proposed per verification round")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -261,6 +285,33 @@ def main(argv: list[str] | None = None) -> int:
         params = shard_params(params, mesh, cfg)
 
     engine = Engine(params, cfg, max_cache_len=max_cache)
+    speculative = None
+    if args.draft_model:
+        from kubeinfer_tpu.inference.speculative import SpeculativeEngine
+
+        if args.random_init:
+            dcfg = PRESETS.get(args.draft_model)
+            if dcfg is None:
+                raise SystemExit(
+                    f"--draft-model {args.draft_model!r} is not a preset "
+                    "(with --random-init the draft must name one)"
+                )
+            dparams = init_params(dcfg, jax.random.PRNGKey(1), dtype=dtype)
+        else:
+            from kubeinfer_tpu.inference.weights import load_pretrained
+
+            dparams, dcfg = load_pretrained(args.draft_model, dtype=dtype)
+        if args.tensor_parallel_size > 1:
+            # the draft shards onto the same tp mesh as the target —
+            # left unsharded, GSPMD would replicate its weights on every
+            # device (tp x the intended draft HBM footprint)
+            from kubeinfer_tpu.inference.sharding import shard_params
+
+            dparams = shard_params(dparams, mesh, dcfg)
+        speculative = SpeculativeEngine(
+            params, cfg, dparams, dcfg, k=args.speculation_depth,
+            max_cache_len=max_cache,
+        )
     continuous = None
     if args.batch_slots > 0:
         from kubeinfer_tpu.inference.batching import ContinuousEngine
@@ -272,6 +323,7 @@ def main(argv: list[str] | None = None) -> int:
     srv = InferenceServer(
         engine, model_id=args.model, tokenizer=tokenizer,
         host=args.host, port=args.port, continuous=continuous,
+        speculative=speculative,
     ).start()
     log.info("native inference server on %s:%d (model %s)",
              args.host, srv.port, args.model)

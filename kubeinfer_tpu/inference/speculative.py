@@ -237,12 +237,7 @@ def _spec_generate_jit(
     else:
         state = state0
     written, counts, accepted, rounds = state[5], state[6], state[8], state[9]
-    return (
-        written[:, : max_new + k + 1],
-        jnp.minimum(counts, max_new),
-        accepted,
-        rounds,
-    )
+    return written, jnp.minimum(counts, max_new), accepted, rounds
 
 
 @dataclass
@@ -266,8 +261,21 @@ class SpeculativeEngine:
                 "draft and target must share a vocabulary "
                 f"({self.draft_cfg.vocab_size} vs {self.cfg.vocab_size})"
             )
+        if self.k < 1:
+            # k=0 would trace a [B, 2] window against k+1=1-column masks
+            # and die with a shape error inside jit on the first request
+            raise ValueError(f"speculation depth k must be >= 1, got {self.k}")
         if not self.max_cache_len:
             self.max_cache_len = self.cfg.max_position_embeddings
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether a request fits this engine's cache INCLUDING the k+1
+        speculation slack — callers (the server) fall back to the plain
+        engine when it does not, instead of failing a request that the
+        target model alone could serve."""
+        return (
+            prompt_len + max_new_tokens + self.k + 1 <= self.max_cache_len
+        )
 
     def generate(
         self,

@@ -154,3 +154,45 @@ class TestRuntimeLauncherIntegration:
     def test_unknown_runtime_kind_rejected(self):
         with pytest.raises(ValueError, match="RUNTIME_KIND"):
             RuntimeConfig.from_env({"RUNTIME_KIND": "tgi"})
+
+
+class TestSpeculativeServing:
+    @pytest.fixture(scope="class")
+    def spec_server(self):
+        from kubeinfer_tpu.inference.speculative import SpeculativeEngine
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        engine = Engine(params, TINY)
+        # self-draft: acceptance 1.0, output must equal vanilla greedy
+        spec = SpeculativeEngine(params, TINY, params, TINY, k=3)
+        srv = InferenceServer(
+            engine, model_id="tiny-spec", port=0, speculative=spec
+        ).start()
+        yield srv, engine
+        srv.stop()
+
+    def test_greedy_request_routes_through_speculation(self, spec_server):
+        srv, engine = spec_server
+        body = {"prompt": [5, 6, 7], "max_tokens": 8, "temperature": 0.0}
+        code, resp = post(
+            f"http://127.0.0.1:{srv.port}/v1/completions", body
+        )
+        assert code == 200
+        ref = engine.generate([[5, 6, 7]], max_new_tokens=8)
+        assert resp["choices"][0]["tokens"] == ref.tokens[0].tolist()
+        # the speculative path actually ran (stats recorded)
+        assert srv.speculative.last_stats["rounds"] >= 1
+
+    def test_sampled_request_skips_speculation(self, spec_server):
+        srv, _ = spec_server
+        srv.speculative.last_stats = None
+        body = {
+            "prompt": [5, 6, 7], "max_tokens": 4,
+            "temperature": 0.8, "seed": 7,
+        }
+        code, resp = post(
+            f"http://127.0.0.1:{srv.port}/v1/completions", body
+        )
+        assert code == 200
+        assert len(resp["choices"][0]["tokens"]) >= 1
+        assert srv.speculative.last_stats is None  # path not taken

@@ -141,3 +141,14 @@ class TestAcceptanceDiagnostics:
         assert out.lengths[0] == 21
         assert eng.last_stats["rounds"] <= 5  # ceil(20/5) + 1 slack
         assert eng.last_stats["accepted_drafts"][0] >= 21 - 1 - eng.last_stats["rounds"]
+
+    def test_depth_below_one_rejected(self, target_params):
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            SpeculativeEngine(target_params, TINY, target_params, TINY, k=0)
+
+    def test_fits_accounts_for_slack(self, target_params):
+        eng = SpeculativeEngine(
+            target_params, TINY, target_params, TINY, k=4, max_cache_len=64
+        )
+        assert eng.fits(32, 27)       # 32+27+5 = 64
+        assert not eng.fits(32, 28)   # 65 > 64
