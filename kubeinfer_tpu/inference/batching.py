@@ -23,6 +23,7 @@ host, math under jit).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import queue
 import threading
@@ -49,13 +50,15 @@ class SlotState:
     offset: jax.Array  # i32[B] next cache position (= current length)
     active: jax.Array  # bool[B]
     temperature: jax.Array  # f32[B]; <=0 = greedy
+    top_k: jax.Array  # i32[B]; <1 = disabled
+    top_p: jax.Array  # f32[B]; >=1 = disabled
     rng: jax.Array  # u32[B, 2] per-slot PRNG key data
 
 
 jax.tree_util.register_dataclass(
     SlotState,
     data_fields=["caches_k", "caches_v", "last_token", "offset", "active",
-                 "temperature", "rng"],
+                 "temperature", "top_k", "top_p", "rng"],
     meta_fields=[],
 )
 
@@ -70,6 +73,8 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
         offset=jnp.zeros((n_slots,), jnp.int32),
         active=jnp.zeros((n_slots,), bool),
         temperature=jnp.zeros((n_slots,), jnp.float32),
+        top_k=jnp.zeros((n_slots,), jnp.int32),
+        top_p=jnp.ones((n_slots,), jnp.float32),
         rng=jnp.zeros((n_slots, 2), jnp.uint32),
     )
 
@@ -77,18 +82,27 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
 def _sample_rows(
     logits: jax.Array,  # f32[B, V]
     temperature: jax.Array,  # f32[B]
+    top_k: jax.Array,  # i32[B]
+    top_p: jax.Array,  # f32[B]
     rng: jax.Array,  # u32[B, 2]
     counter: jax.Array,  # i32[B] — folded in so each step draws fresh noise
 ) -> jax.Array:
-    from kubeinfer_tpu.inference.engine import gumbel_sample
+    from kubeinfer_tpu.inference.engine import filter_logits, gumbel_pick
 
-    def sample_one(row_logits, key_data, ctr, temp):
+    # filter at BATCH level so filter_logits' lax.cond fast-paths engage
+    # (inside the vmap a batched predicate would lower to select and pay
+    # the full-vocab nucleus sort on every step even with filters off);
+    # only the per-row gumbel pick is vmapped
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = filter_logits(scaled, top_k, top_p)
+
+    def pick_one(row_logits, row_filtered, key_data, ctr, temp):
         key = jax.random.fold_in(
             jax.random.wrap_key_data(key_data, impl="threefry2x32"), ctr
         )
-        return gumbel_sample(row_logits, key, temp)
+        return gumbel_pick(row_logits, row_filtered, key, temp)
 
-    return jax.vmap(sample_one)(logits, rng, counter, temperature)
+    return jax.vmap(pick_one)(logits, filtered, rng, counter, temperature)
 
 
 @functools.partial(
@@ -124,11 +138,17 @@ def _decode_step(
     # so folding the bare offset here would reuse the admit-time gumbel
     # draw and systematically double the first sampled token
     nxt = _sample_rows(
-        logits[:, 0], state.temperature, state.rng, state.offset + 1
+        logits[:, 0], state.temperature, state.top_k, state.top_p,
+        state.rng, state.offset + 1,
     )
 
     keep = state.active
-    new_state = SlotState(
+    # dataclasses.replace carries unchanged fields automatically — a
+    # full-constructor copy here silently reset any SlotState field
+    # added later (this diff had to hand-thread top_k/top_p through two
+    # such copies before the conversion)
+    new_state = dataclasses.replace(
+        state,
         caches_k=[
             jnp.where(keep[:, None, None, None], nk, ok)
             for nk, ok in zip(new_k, state.caches_k)
@@ -139,9 +159,6 @@ def _decode_step(
         ],
         last_token=jnp.where(keep, nxt, state.last_token),
         offset=jnp.where(keep, state.offset + 1, state.offset),
-        active=state.active,
-        temperature=state.temperature,
-        rng=state.rng,
     )
     return new_state, jnp.where(keep, nxt, -1)
 
@@ -155,6 +172,8 @@ def _admit_slot(
     cfg: ModelConfig,
     slot: jax.Array,  # i32[] — traced, or admission compiles per slot
     temperature: jax.Array,  # f32[]
+    top_k: jax.Array,  # i32[]
+    top_p: jax.Array,  # f32[]
     key_data: jax.Array,  # u32[2] per-request PRNG key data
 ) -> SlotState:
     """Prefill one request into slot ``slot`` (compiled per T bucket)."""
@@ -180,8 +199,8 @@ def _admit_slot(
     )
     last = jnp.clip(prompt_len - 1, 0, T - 1)
     first = _sample_rows(
-        logits[:, last], temperature[None], key_data[None],
-        prompt_len[None],
+        logits[:, last], temperature[None], top_k[None], top_p[None],
+        key_data[None], prompt_len[None],
     )[0]
 
     def put(big, small):
@@ -189,13 +208,16 @@ def _admit_slot(
             big, small, (slot, 0, 0, 0)
         )
 
-    return SlotState(
+    return dataclasses.replace(
+        state,
         caches_k=[put(b, c[0]) for b, c in zip(state.caches_k, caches)],
         caches_v=[put(b, c[1]) for b, c in zip(state.caches_v, caches)],
         last_token=state.last_token.at[slot].set(first),
         offset=state.offset.at[slot].set(prompt_len),
         active=state.active.at[slot].set(True),
         temperature=state.temperature.at[slot].set(temperature),
+        top_k=state.top_k.at[slot].set(top_k),
+        top_p=state.top_p.at[slot].set(top_p),
         rng=state.rng.at[slot].set(key_data),
     )
 
@@ -209,6 +231,8 @@ class _Request:
     max_new: int
     eos_id: int
     temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
     seed: int = 0
     out_tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -260,7 +284,8 @@ class ContinuousEngine:
 
     def submit(self, prompt: list[int], max_new_tokens: int = 32,
                eos_id: int = -1, temperature: float = 0.0,
-               seed: int = 0) -> _Request:
+               seed: int = 0, top_k: int = 0,
+               top_p: float = 1.0) -> _Request:
         if not prompt:
             raise ValueError("empty prompt")
         if not self.fits(len(prompt), max_new_tokens):
@@ -273,15 +298,18 @@ class ContinuousEngine:
                 f"capacity ({self.cache_len})"
             )
         req = _Request(prompt, max_new_tokens, eos_id,
-                       temperature=temperature, seed=seed)
+                       temperature=temperature, top_k=top_k, top_p=top_p,
+                       seed=seed)
         self._queue.put(req)
         return req
 
     def generate(self, prompt: list[int], max_new_tokens: int = 32,
                  eos_id: int = -1, temperature: float = 0.0,
-                 seed: int = 0, timeout: float = 300.0) -> list[int]:
+                 seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                 timeout: float = 300.0) -> list[int]:
         req = self.submit(prompt, max_new_tokens, eos_id,
-                          temperature=temperature, seed=seed)
+                          temperature=temperature, seed=seed,
+                          top_k=top_k, top_p=top_p)
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
@@ -333,7 +361,8 @@ class ContinuousEngine:
         self._state = _admit_slot(
             self.params, self._state, jnp.asarray(padded),
             jnp.int32(len(req.prompt)), self.cfg, jnp.int32(slot),
-            jnp.float32(req.temperature), key_data,
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), key_data,
         )
         self._slot_req[slot] = req
         # the prefill already produced the first generated token
@@ -355,14 +384,9 @@ class ContinuousEngine:
         )
         if finished:
             self._slot_req[slot] = None
-            self._state = SlotState(
-                caches_k=self._state.caches_k,
-                caches_v=self._state.caches_v,
-                last_token=self._state.last_token,
-                offset=self._state.offset,
+            self._state = dataclasses.replace(
+                self._state,
                 active=self._state.active.at[slot].set(False),
-                temperature=self._state.temperature,
-                rng=self._state.rng,
             )
             req.done.set()
 

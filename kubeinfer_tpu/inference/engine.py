@@ -54,19 +54,100 @@ class GenerationResult:
     lengths: np.ndarray  # i32[B] generated length per sequence
 
 
+# Static cap for the top-k filter: lax.top_k needs a static k, so the
+# kth-largest threshold reads from a fixed [.., TOP_K_CAP] candidate
+# slice; requested k above the cap clips to it (k=64 is already far past
+# any practically distinguishable nucleus).
+TOP_K_CAP = 64
+
+
+def filter_logits(
+    logits: jax.Array,  # f32[..., V]
+    top_k: jax.Array,  # i32 broadcastable to logits[..., 0]; <1 = off
+    top_p: jax.Array,  # f32 broadcastable to logits[..., 0]; >=1 = off
+) -> jax.Array:
+    """Top-k then nucleus (top-p) filtering: non-kept logits -> -inf.
+
+    Both knobs are traced (per-row in the continuous batcher), so the
+    expensive parts — the top-k candidate scan and the full-vocab sort
+    nucleus needs — sit behind ``lax.cond`` on "any row has the filter
+    on": a disabled filter costs nothing per decode step at runtime.
+    Order matches HF: temperature scaling happens in the caller BEFORE
+    filtering, so top-p nuclei are computed on the tempered
+    distribution.
+    """
+    V = logits.shape[-1]
+    cap = min(TOP_K_CAP, V)
+    lead = logits.shape[:-1]
+    top_k = jnp.broadcast_to(top_k, lead)
+    top_p = jnp.broadcast_to(top_p, lead)
+
+    def apply_topk(x):
+        topvals = jax.lax.top_k(x, cap)[0]  # [..., cap] descending
+        k_idx = jnp.clip(top_k - 1, 0, cap - 1)[..., None]
+        kth = jnp.take_along_axis(topvals, k_idx, axis=-1)
+        on = (top_k >= 1)[..., None]
+        return jnp.where(on & (x < kth), -jnp.inf, x)
+
+    def apply_topp(x):
+        probs = jax.nn.softmax(x, axis=-1)
+        sorted_p = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+        cum_excl = jnp.cumsum(sorted_p, axis=-1) - sorted_p
+        keep_sorted = cum_excl < top_p[..., None]
+        # the argmax always survives, even for top_p <= 0 (where the
+        # cumulative test keeps nothing and sampling would otherwise
+        # collapse to token id 0 via an all -inf row)
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_p, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        on = (top_p < 1.0)[..., None]
+        return jnp.where(on & (probs < thresh), -jnp.inf, x)
+
+    logits = jax.lax.cond(
+        jnp.any(top_k >= 1), apply_topk, lambda x: x, logits
+    )
+    return jax.lax.cond(
+        jnp.any(top_p < 1.0), apply_topp, lambda x: x, logits
+    )
+
+
+def gumbel_pick(
+    raw_logits: jax.Array,
+    filtered_scaled: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+) -> jax.Array:
+    """Final sampling step shared by every path: greedy argmax on the
+    RAW logits when temperature <= 0, gumbel-argmax on the pre-tempered,
+    pre-filtered logits otherwise. Split out so the continuous batcher
+    can run ``filter_logits`` once at batch level (its lax.cond
+    fast-path dies under vmap — a batched predicate lowers to select)
+    and still share this exact pick."""
+    greedy = jnp.argmax(raw_logits, axis=-1).astype(jnp.int32)
+    g = jax.random.gumbel(key, raw_logits.shape, jnp.float32)
+    sampled = jnp.argmax(filtered_scaled + g, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 def gumbel_sample(
-    logits: jax.Array, key: jax.Array, temperature: jax.Array
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array | int = 0,
+    top_p: jax.Array | float = 1.0,
 ) -> jax.Array:
     """Temperature sampling via the gumbel trick; temperature <= 0 means
-    greedy. ONE home for the sampling math — the per-request engine and
-    the continuous batcher must sample identically for the same params.
+    greedy (filters don't apply — argmax always survives both). ONE home
+    for the sampling math — the per-request engine and the continuous
+    batcher must sample identically for the same params.
     """
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    g = jax.random.gumbel(key, logits.shape, jnp.float32)
-    sampled = jnp.argmax(
-        logits / jnp.maximum(temperature, 1e-6) + g, axis=-1
-    ).astype(jnp.int32)
-    return jnp.where(temperature > 0, sampled, greedy)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    filtered = filter_logits(
+        scaled, jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32)
+    )
+    return gumbel_pick(logits, filtered, key, temperature)
 
 
 def chunked_prefill(
@@ -154,6 +235,8 @@ def _generate_jit(
     prefill_chunk: int,
     eos_id: jax.Array,  # i32 (negative = never stop)
     temperature: jax.Array,  # f32; <=0 = greedy
+    top_k: jax.Array,  # i32; <1 = disabled
+    top_p: jax.Array,  # f32; >=1 = disabled
     rng_key: jax.Array,
 ):
     B, T = prompt.shape
@@ -170,7 +253,7 @@ def _generate_jit(
     )
 
     def sample(logits, key):
-        return gumbel_sample(logits, key, temperature)
+        return gumbel_sample(logits, key, temperature, top_k, top_p)
 
     k0, krest = jax.random.split(rng_key)
     first = sample(next_logits, k0)
@@ -265,6 +348,8 @@ class Engine:
         eos_id: int = -1,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> GenerationResult:
         """Batch generation, exact for ragged prompts.
 
@@ -296,6 +381,8 @@ class Engine:
                 PREFILL_CHUNK,
                 jnp.int32(eos_id),
                 jnp.float32(temperature),
+                jnp.int32(top_k),
+                jnp.float32(top_p),
                 # fold the group length in: identical keys across length
                 # groups would sample rows of different groups in
                 # lockstep (within a group the batch axis decorrelates)

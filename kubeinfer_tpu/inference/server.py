@@ -126,6 +126,12 @@ class InferenceServer:
         if not (0 < max_tokens <= 4096):
             raise ValueError("max_tokens must be in (0, 4096]")
         temperature = float(body.get("temperature", 0.0))
+        top_k = int(body.get("top_k", 0))
+        top_p = float(body.get("top_p", 1.0))
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 disables)")
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1]")
         seed = int(body.get("seed", 0))
         eos_id = -1
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
@@ -160,11 +166,13 @@ class InferenceServer:
             gen = self.continuous.generate(
                 ids, max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p,
             )
         else:
             out = self.engine.generate(
                 [ids], max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p,
             )
             gen = out.tokens[0, : out.lengths[0]].tolist()
         # "stop" iff the sequence actually terminated on EOS — including

@@ -254,6 +254,8 @@ class SpeculativeEngine:
     draft_cfg: ModelConfig
     k: int = 4
     max_cache_len: int = 0
+    # diagnostics from the most recent generate(); None before any call
+    last_stats: dict | None = None
 
     def __post_init__(self):
         if self.cfg.vocab_size != self.draft_cfg.vocab_size:

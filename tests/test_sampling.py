@@ -1,0 +1,140 @@
+"""Top-k / nucleus (top-p) sampling filters.
+
+filter_logits is the one home for the math; behavioral pins: top_k=1
+is greedy at any temperature, a nucleus no wider than the argmax is
+greedy, disabled knobs are the identity, and the continuous batcher
+applies per-slot values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from kubeinfer_tpu.inference import PRESETS, init_params
+from kubeinfer_tpu.inference.engine import (
+    Engine,
+    TOP_K_CAP,
+    filter_logits,
+    gumbel_sample,
+)
+
+TINY = PRESETS["tiny"]
+
+
+def _logits(seed=0, B=2, V=32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(B, V)).astype(np.float32))
+
+
+class TestFilterLogits:
+    def test_disabled_is_identity(self):
+        x = _logits()
+        y = filter_logits(x, jnp.int32(0), jnp.float32(1.0))
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_top_k_keeps_exactly_k(self):
+        x = _logits(1)
+        for k in (1, 3, 7):
+            y = np.asarray(filter_logits(x, jnp.int32(k), jnp.float32(1.0)))
+            assert ((y > -np.inf).sum(axis=-1) == k).all()
+            # the survivors are the k largest
+            for b in range(x.shape[0]):
+                top = np.argsort(np.asarray(x[b]))[-k:]
+                assert set(np.nonzero(y[b] > -np.inf)[0]) == set(top)
+
+    def test_top_k_above_cap_clips(self):
+        V = TOP_K_CAP * 2
+        x = _logits(2, B=1, V=V)
+        y = np.asarray(
+            filter_logits(x, jnp.int32(V), jnp.float32(1.0))
+        )
+        assert (y > -np.inf).sum() == TOP_K_CAP
+
+    def test_top_p_keeps_minimal_nucleus(self):
+        # known distribution so the nucleus boundary is exact
+        x = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]], jnp.float32))
+        y = np.asarray(filter_logits(x, jnp.int32(0), jnp.float32(0.7)))
+        # cumulative(exclusive): 0, .5, .75 -> keep p0, p1, and p2 (the
+        # first whose exclusive sum .75 >= .7 is dropped)
+        assert (y[0] > -np.inf).tolist() == [True, True, False, False]
+
+    def test_top_p_always_keeps_argmax(self):
+        x = _logits(3)
+        y = np.asarray(filter_logits(x, jnp.int32(0), jnp.float32(1e-6)))
+        kept = (y > -np.inf)
+        assert (kept.sum(axis=-1) == 1).all()
+        assert (np.argmax(np.asarray(x), -1) == np.argmax(y, -1)).all()
+
+    def test_per_row_knobs(self):
+        x = _logits(4, B=3)
+        y = np.asarray(filter_logits(
+            x, jnp.asarray([1, 0, 4], jnp.int32),
+            jnp.asarray([1.0, 1e-6, 1.0], jnp.float32),
+        ))
+        assert (y[0] > -np.inf).sum() == 1  # top_k=1
+        assert (y[1] > -np.inf).sum() == 1  # nucleus = argmax
+        assert (y[2] > -np.inf).sum() == 4  # top_k=4
+
+
+class TestSamplingBehavior:
+    def test_top_k_one_is_greedy_at_any_temperature(self):
+        x = _logits(5)
+        key = jax.random.PRNGKey(0)
+        got = gumbel_sample(x, key, jnp.float32(5.0), top_k=1)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(jnp.argmax(x, -1))
+        )
+
+    def test_samples_stay_inside_top_k(self):
+        x = _logits(6, B=1, V=16)
+        top3 = set(np.argsort(np.asarray(x[0]))[-3:].tolist())
+        for s in range(40):
+            t = gumbel_sample(
+                x, jax.random.PRNGKey(s), jnp.float32(2.0), top_k=3
+            )
+            assert int(t[0]) in top3
+
+    def test_engine_generate_top_k_one_matches_greedy(self):
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = Engine(params, TINY)
+        prompts = [[4, 5, 6, 7]]
+        ref = eng.generate(prompts, max_new_tokens=6)  # greedy
+        got = eng.generate(
+            prompts, max_new_tokens=6, temperature=1.5, top_k=1
+        )
+        np.testing.assert_array_equal(got.tokens, ref.tokens)
+
+    def test_continuous_engine_per_slot_filters(self):
+        from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = Engine(params, TINY)
+        cont = ContinuousEngine(params, TINY, n_slots=2, cache_len=64)
+        cont.start()
+        try:
+            ref = eng.generate([[3, 4, 5]], max_new_tokens=5)
+            # top_k=1 at high temperature must equal greedy even through
+            # the slot path
+            got = cont.generate(
+                [3, 4, 5], max_new_tokens=5, temperature=3.0, top_k=1
+            )
+            assert got == ref.tokens[0].tolist()
+        finally:
+            cont.stop()
+
+    def test_top_p_zero_still_samples_argmax(self):
+        # top_p <= 0 collapsed to an all -inf row emitting token 0 before
+        # the argmax-always-survives guard (r2 review finding)
+        x = _logits(7)
+        y = np.asarray(filter_logits(x, jnp.int32(0), jnp.float32(0.0)))
+        assert ((y > -np.inf).sum(axis=-1) == 1).all()
+        assert (np.argmax(y, -1) == np.argmax(np.asarray(x), -1)).all()
+        t = gumbel_sample(x, jax.random.PRNGKey(0), jnp.float32(2.0),
+                          top_p=0.0)
+        np.testing.assert_array_equal(
+            np.asarray(t), np.asarray(jnp.argmax(x, -1))
+        )
