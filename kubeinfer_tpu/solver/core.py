@@ -22,6 +22,12 @@ Two device-side algorithms, selected per job via ``schedulerPolicy``:
   high-priority class only discovers a round later; the fence closes that
   without serializing priority classes into gated phases (all levels make
   progress in the same round on disjoint nodes).
+  Round count at the 10k x 1k benchmark shape is ~12 and is set by this
+  fence pipeline (~3 settlement rounds per fence class), NOT by per-node
+  conflict churn: the joint-fit accept already admits all bidders on
+  typically contended nodes, so richer conflict resolution (measured:
+  winner-first fair-share multi-accept) does not reduce rounds. Shaving
+  rounds further means relaxing fence granularity, a correctness trade.
 
 ``solve_auction`` — Bertsekas-style auction for one-replica-per-node
   instances (whole-node requests), giving Hungarian-quality assignments
