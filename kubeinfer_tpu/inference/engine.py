@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
-from kubeinfer_tpu.inference.flash_attention import attention_auto
+from kubeinfer_tpu.inference.flash_attention import (
+    attention_auto,
+    flash_attention_ragged,
+    flash_available,
+)
 from kubeinfer_tpu.inference.model import Params, forward
 
 PROMPT_BUCKETS = (
@@ -172,26 +176,38 @@ def chunked_prefill(
     C = min(T, prefill_chunk)
     pos = jnp.arange(cache_len)
     last = jnp.clip(prompt_len - 1, 0, T - 1)
+    D = cfg.head_dim
+    # static branch: kernel vs dense is decided by shapes/backend at
+    # trace time, so only one path exists in the compiled program
+    use_flash = flash_available(C, cache_len, D)
 
     def prefill_step(carry, c0):
         caches, next_logits = carry
         chunk = jax.lax.dynamic_slice(prompt, (0, c0), (B, C))
         q_pos = c0 + jnp.arange(C)
         # attend to cache positions <= own position, and only to real
-        # (non-pad) prompt positions
+        # (non-pad) prompt positions. On the flash path this bool
+        # [B, C, cache_len] is never consumed (the kernel derives the
+        # identical mask in-kernel from (c0, prompt_len) iotas) and XLA
+        # dead-code-eliminates its construction — nothing [T, S]-sized
+        # exists at runtime there.
         mask = (
             (pos[None, None, :] <= q_pos[None, :, None])
             & (pos[None, None, :] < prompt_len[:, None, None])
         )
         mask = jnp.broadcast_to(mask, (B, C, cache_len))
-        # attention_auto: Pallas flash kernel on TPU-aligned shapes
-        # (streams the [C, cache_len] scores through VMEM), dense jnp
-        # elsewhere. Numerically equivalent within dtype tolerance, NOT
-        # bit-identical (online-softmax reorders the summation), so
-        # near-tied greedy decodes may differ across backends.
+        if use_flash:
+            def attn_fn(q, k, v, _mask):
+                return flash_attention_ragged(q, k, v, c0, prompt_len)
+        else:
+            # dense jnp path. Numerically equivalent to flash within
+            # dtype tolerance, NOT bit-identical (online-softmax
+            # reorders the summation), so near-tied greedy decodes may
+            # differ across backends.
+            attn_fn = attention_auto
         logits, caches = forward(
             params, chunk, cfg, attn_mask=mask, kv_caches=caches,
-            cache_offset=c0, attn_fn=attention_auto,
+            cache_offset=c0, attn_fn=attn_fn,
         )
         # the row's next-token logits live in whichever chunk holds its
         # LAST REAL prompt position

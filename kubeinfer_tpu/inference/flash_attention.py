@@ -3,31 +3,35 @@
 The dense path (model.attention) materializes [B, n_kv, G, T, S] f32
 scores through HBM; at long context that is the dominant memory term
 (a 512-token chunk against a 128k cache is 0.5GB of scores per layer at
-B=8, H=32). This kernel streams K/V tiles through VMEM with the online
+B=8, H=32). These kernels stream K/V tiles through VMEM with the online
 softmax recurrence (running rowmax m, normalizer l, accumulator o — the
 same algebra as ring_attention.py's block fold, here over the LOCAL S
 axis instead of a device ring), so the f32 score/probability tensors
-never touch HBM. The caller's bool[B, T, S] mask does still ship to the
-kernel (as int8, head-independent — 4*n_kv*G times smaller than the
-scores it replaces); deriving the engine's causal/ragged mask in-kernel
-from (chunk offset, row lengths) iotas would remove that last
-[T, S]-sized term and is the natural next step if profiles demand it.
+never touch HBM.
+
+Two mask sources share one softmax body (``_softmax_fold``):
+
+- ``flash_attention``: a caller-supplied bool[B, T, S] mask ships to
+  the kernel as int8 (head-independent — 4*n_kv*G times smaller than
+  the scores it replaces). General, matches model.attention's
+  signature, plugs into ``forward(attn_fn=...)`` via
+  ``attention_auto``.
+- ``flash_attention_ragged``: the engine's chunked-prefill mask
+  ((s <= chunk_offset + t) & (s < row_len)) derived IN-KERNEL from two
+  scalars via iotas — nothing [T, S]-sized exists anywhere, in HBM or
+  out. This is the engine's TPU prefill path.
 
 Layout: GQA folds the (T, G) axes into MXU rows — q becomes
 [B*n_kv, T*G, D], each S tile is one [T_q*G, D] x [D, S_k] matmul plus
-one [T_q*G, S_k] x [S_k, D] matmul, and the boolean mask (which depends
+one [T_q*G, S_k] x [S_k, D] matmul, and the mask penalty (which depends
 on T alone, not G) broadcasts across the G subrows in-register. The S
 grid axis is innermost with the accumulators in VMEM scratch, so state
 stays resident across the sweep (same accumulate-across-grid idiom as
 the solver's accept kernel).
 
-The public entry ``flash_attention`` matches model.attention's signature
-([B, T, H, D] q, [B, S, n_kv, D] k/v, bool[B, T, S] mask) so it plugs
-into ``forward(attn_fn=...)`` unchanged; ``attention_auto`` picks the
-kernel when the backend and shapes allow and falls back to the dense
-jnp path otherwise. Fully-masked rows reproduce the dense path's
-uniform-softmax output exactly (all scores -1e30 -> p == 1 everywhere
--> o/l is the mean over S), so parity holds even on padding rows.
+Fully-masked rows reproduce the dense path's uniform-softmax output
+exactly (all scores -1e30 -> p == 1 everywhere -> o/l is the mean over
+S), so parity holds even on padding rows.
 
 No reference counterpart: the reference delegates all attention to the
 external vLLM process (SURVEY.md §2, vllm.go:93-112).
@@ -48,11 +52,11 @@ TILE_T = 256  # query positions per tile (rows = TILE_T * G)
 TILE_S = 512  # key/value positions per tile
 
 
-def _flash_kernel(
+def _softmax_fold(
     q_ref,  # [1, TILE_T * G, D] folded (t, g) query rows
     k_ref,  # [1, TILE_S, D]
     v_ref,  # [1, TILE_S, D]
-    mask_ref,  # [1, TILE_T, TILE_S] int8 (1 = attend)
+    pen,  # f32[TILE_T, TILE_S]: 0 = attend, -1e30 = masked
     o_ref,  # [1, TILE_T * G, D] out
     m_scr,  # f32[TILE_T * G, 1] scratch: running rowmax
     l_scr,  # f32[TILE_T * G, 1] scratch: running normalizer
@@ -62,6 +66,9 @@ def _flash_kernel(
     scale: float,
     s_tiles: int,
 ):
+    """One S-tile step of the online softmax, shared by both kernels —
+    the recurrence, scratch lifecycle, and GQA penalty broadcast must
+    never diverge between the mask-tensor and iota-mask variants."""
     ts = pl.program_id(2)  # innermost: S sweep with resident scratch
 
     @pl.when(ts == 0)
@@ -77,9 +84,8 @@ def _flash_kernel(
     ) * scale  # [TqG, Sk]
     # Masking as an f32 additive penalty broadcast across the G subrows.
     # Mosaic cannot relayout i1 vectors ("unsupported shape cast" on a
-    # bool [Tq, 1, Sk] broadcast), so the bool never changes rank: it
-    # converts to f32 first, and the rank changes happen on f32 values.
-    pen = (mask_ref[0].astype(jnp.float32) - 1.0) * 1e30  # 0 attend, -1e30 not
+    # bool [Tq, 1, Sk] broadcast), so rank changes happen on f32 values;
+    # the add is exact (|s| << 1e23, so s + -1e30 rounds to -1e30).
     tq, sk = pen.shape
     s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
         tq * groups, sk
@@ -107,6 +113,114 @@ def _flash_kernel(
         )
 
 
+def _flash_kernel(
+    mask_ref,  # [1, TILE_T, TILE_S] int8 (1 = attend); extras lead
+    q_ref, k_ref, v_ref,
+    o_ref, m_scr, l_scr, acc_scr,
+    *, groups: int, scale: float, s_tiles: int,
+):
+    pen = (mask_ref[0].astype(jnp.float32) - 1.0) * 1e30
+    _softmax_fold(
+        q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
+        groups=groups, scale=scale, s_tiles=s_tiles,
+    )
+
+
+def _flash_ragged_kernel(
+    c0_ref,  # SMEM i32[1]: global position of the first query row
+    len_ref,  # SMEM i32[1]: this batch row's valid sequence length
+    q_ref, k_ref, v_ref,
+    o_ref, m_scr, l_scr, acc_scr,
+    *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+):
+    """The engine's prefill mask — attend cache slots <= own global
+    position AND < the row's valid length — from iotas on two scalars
+    instead of a shipped [B, T, S] int8 tensor."""
+    tq = pl.program_id(1)
+    ts = pl.program_id(2)
+    q_pos = (
+        c0_ref[0] + tq * tile_t
+        + jax.lax.broadcasted_iota(jnp.int32, (tile_t, tile_s), 0)
+    )
+    s_pos = ts * tile_s + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_t, tile_s), 1
+    )
+    attend = (s_pos <= q_pos) & (s_pos < len_ref[0])
+    pen = jnp.where(attend, 0.0, -1e30)  # i1 never changes rank
+    _softmax_fold(
+        q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
+        groups=groups, scale=scale, s_tiles=s_tiles,
+    )
+
+
+def _run_flash(
+    kern,
+    extra_arrays: tuple,
+    extra_specs: list,
+    q: jax.Array,  # [B, T, n_heads, D]
+    k: jax.Array,  # [B, S, n_kv, D]
+    v: jax.Array,
+    tile_t: int,
+    tile_s: int,
+    interpret: bool,
+    name: str,
+) -> jax.Array:
+    """Shared host plumbing: GQA row fold, tile validation, pallas_call,
+    and the inverse fold. ``extra_arrays``/``extra_specs`` prepend the
+    kernel's mask source (int8 tensor or SMEM scalars)."""
+    B, T, n_heads, D = q.shape
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_t = min(tile_t, T)
+    tile_s = min(tile_s, S)
+    if T % tile_t or S % tile_s:
+        raise ValueError(
+            f"{name} needs T divisible by {tile_t} and S by {tile_s}; "
+            f"got T={T} S={S} (use attention_auto for fallback)"
+        )
+    t_tiles, s_tiles = T // tile_t, S // tile_s
+
+    # fold (B, n_kv) into the grid axis and (T, G) into MXU rows
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4)
+    qf = qf.reshape(B * n_kv, T * G, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+
+    out = pl.pallas_call(
+        functools.partial(
+            kern, groups=G, scale=1.0 / float(D) ** 0.5, s_tiles=s_tiles
+        ),
+        grid=(B * n_kv, t_tiles, s_tiles),
+        in_specs=extra_specs + [
+            pl.BlockSpec(
+                (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*extra_arrays, qf, kf, vf)
+    out = out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, T, n_heads, D)
+
+
 def flash_attention(
     q: jax.Array,  # [B, T, n_heads, D]
     k: jax.Array,  # [B, S, n_kv, D]
@@ -122,71 +236,62 @@ def flash_attention(
     Callers wanting automatic fallback for unaligned shapes use
     ``attention_auto``.
     """
-    B, T, n_heads, D = q.shape
-    S, n_kv = k.shape[1], k.shape[2]
-    G = n_heads // n_kv
-    tile_t = min(tile_t, T)
-    tile_s = min(tile_s, S)
-    if T % tile_t or S % tile_s:
-        raise ValueError(
-            f"flash_attention needs T divisible by {tile_t} and S by "
-            f"{tile_s}; got T={T} S={S} (use attention_auto for fallback)"
-        )
-    t_tiles, s_tiles = T // tile_t, S // tile_s
-
-    # fold (B, n_kv) into the grid axis and (T, G) into MXU rows
-    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4)
-    qf = qf.reshape(B * n_kv, T * G, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
-    mask8 = mask.astype(jnp.int8)
-
-    kern = functools.partial(
+    n_kv = k.shape[2]
+    tt = min(tile_t, q.shape[1])
+    ts_ = min(tile_s, k.shape[1])
+    return _run_flash(
         _flash_kernel,
-        groups=G,
-        scale=1.0 / float(D) ** 0.5,
-        s_tiles=s_tiles,
-    )
-    out = pl.pallas_call(
-        kern,
-        grid=(B * n_kv, t_tiles, s_tiles),
-        in_specs=[
+        (mask.astype(jnp.int8),),
+        [
             pl.BlockSpec(
-                (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, tile_t, tile_s),
+                (1, tt, ts_),
                 lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv, tq, ts),
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
-            memory_space=pltpu.VMEM,
+        q, k, v, tile_t, tile_s, interpret, "flash_attention",
+    )
+
+
+def flash_attention_ragged(
+    q: jax.Array,  # [B, T, n_heads, D]
+    k: jax.Array,  # [B, S, n_kv, D]
+    v: jax.Array,  # [B, S, n_kv, D]
+    q_offset: jax.Array,  # i32 scalar: global position of q[:, 0]
+    row_lens: jax.Array,  # i32[B] valid sequence length per row
+    *,
+    tile_t: int = TILE_T,
+    tile_s: int = TILE_S,
+    interpret: bool = False,
+) -> jax.Array:
+    """flash_attention specialized to the chunked-prefill mask
+    ``(s <= q_offset + t) & (s < row_lens[b])``, computed in-kernel from
+    scalars — nothing [T, S]-sized exists anywhere, in HBM or out."""
+    n_kv = k.shape[2]
+    tt = min(tile_t, q.shape[1])
+    ts_ = min(tile_s, k.shape[1])
+    kern = functools.partial(_flash_ragged_kernel, tile_t=tt, tile_s=ts_)
+    return _run_flash(
+        kern,
+        (
+            jnp.asarray(q_offset, jnp.int32).reshape(1),
+            jnp.asarray(row_lens, jnp.int32),
         ),
-        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((tile_t * G, 1), jnp.float32),
-            pltpu.VMEM((tile_t * G, 1), jnp.float32),
-            pltpu.VMEM((tile_t * G, D), jnp.float32),
+        [
+            pl.BlockSpec(
+                (1,), lambda bh, tq, ts: (0,), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (1,), lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv,),
+                memory_space=pltpu.SMEM,
+            ),
         ],
-        interpret=interpret,
-    )(qf, kf, vf, mask8)
-    out = out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4)
-    return out.reshape(B, T, n_heads, D)
+        q, k, v, tile_t, tile_s, interpret, "flash_attention_ragged",
+    )
 
 
 def flash_available(T: int, S: int, D: int) -> bool:
-    """Shapes the kernel handles on the current default backend.
+    """Shapes the kernels handle on the current default backend.
 
     Deliberately conservative: a wrong True here is a Mosaic compile
     error at trace time (there is no catchable fallback once the outer
