@@ -115,14 +115,17 @@ def _chained_solver(req, k):
     return chained, p
 
 
-def device_solve_ms(req, k_short=4, k_long=20, reps=5):
+def device_solve_ms(req, k_short=4, k_long=40, reps=5):
     """Pure device-compute per-solve time via chain differencing.
 
     Times a k_short-solve chain and a k_long-solve chain (each ONE
     dispatch+readback) and reports (t_long - t_short) / (k_long -
     k_short): the transport round trip appears identically in both and
     cancels exactly, unlike floor-subtraction (transport jitter is
-    ~±20ms here, larger than the whole signal).
+    ~±20ms here, larger than the whole signal). The 36-solve spread
+    keeps the differenced signal (~130ms at 10k x 1k) well above relay
+    jitter spikes (observed up to ~50ms), which at a narrower spread
+    moved the reported number by +-2ms between runs.
     Also returns the median one-dispatch floor for reporting.
     """
     import jax
@@ -324,7 +327,7 @@ def main() -> None:
     jax_stats = time_backend(jax_backend, req, reps)
     native_stats = time_backend(native, req, max(reps // 2, 3))
     dev_ms, floor_ms, floor_jitter_ms = device_solve_ms(
-        req, k_short=2 if args.quick else 4, k_long=10 if args.quick else 20,
+        req, k_short=2 if args.quick else 4, k_long=10 if args.quick else 40,
         reps=3 if args.quick else 5,
     )
 

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
-from kubeinfer_tpu.inference.engine import _bucket
+from kubeinfer_tpu.inference.engine import _bucket, record_seen
 from kubeinfer_tpu.inference.model import Params, forward
 
 # --- device state ----------------------------------------------------------
@@ -52,13 +52,16 @@ class SlotState:
     temperature: jax.Array  # f32[B]; <=0 = greedy
     top_k: jax.Array  # i32[B]; <1 = disabled
     top_p: jax.Array  # f32[B]; >=1 = disabled
+    rep_penalty: jax.Array  # f32[B]; 1.0 = disabled
+    seen: jax.Array  # bool[B, V] ids in prompt or generated so far
     rng: jax.Array  # u32[B, 2] per-slot PRNG key data
 
 
 jax.tree_util.register_dataclass(
     SlotState,
     data_fields=["caches_k", "caches_v", "last_token", "offset", "active",
-                 "temperature", "top_k", "top_p", "rng"],
+                 "temperature", "top_k", "top_p", "rep_penalty",
+                 "seen", "rng"],
     meta_fields=[],
 )
 
@@ -75,6 +78,8 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
         temperature=jnp.zeros((n_slots,), jnp.float32),
         top_k=jnp.zeros((n_slots,), jnp.int32),
         top_p=jnp.ones((n_slots,), jnp.float32),
+        rep_penalty=jnp.ones((n_slots,), jnp.float32),
+        seen=jnp.zeros((n_slots, cfg.vocab_size), bool),
         rng=jnp.zeros((n_slots, 2), jnp.uint32),
     )
 
@@ -84,10 +89,18 @@ def _sample_rows(
     temperature: jax.Array,  # f32[B]
     top_k: jax.Array,  # i32[B]
     top_p: jax.Array,  # f32[B]
+    rep_penalty: jax.Array,  # f32[B]
+    seen: jax.Array,  # bool[B, V]
     rng: jax.Array,  # u32[B, 2]
     counter: jax.Array,  # i32[B] — folded in so each step draws fresh noise
 ) -> jax.Array:
-    from kubeinfer_tpu.inference.engine import filter_logits, gumbel_pick
+    from kubeinfer_tpu.inference.engine import (
+        apply_repetition_penalty,
+        filter_logits,
+        gumbel_pick,
+    )
+
+    logits = apply_repetition_penalty(logits, seen, rep_penalty)
 
     # filter at BATCH level so filter_logits' lax.cond fast-paths engage
     # (inside the vmap a batched predicate would lower to select and pay
@@ -139,7 +152,7 @@ def _decode_step(
     # draw and systematically double the first sampled token
     nxt = _sample_rows(
         logits[:, 0], state.temperature, state.top_k, state.top_p,
-        state.rng, state.offset + 1,
+        state.rep_penalty, state.seen, state.rng, state.offset + 1,
     )
 
     keep = state.active
@@ -159,6 +172,13 @@ def _decode_step(
         ],
         last_token=jnp.where(keep, nxt, state.last_token),
         offset=jnp.where(keep, state.offset + 1, state.offset),
+        # record_seen self-gates on any-penalty-enabled; masking by
+        # keep afterwards preserves inactive slots
+        seen=jnp.where(
+            keep[:, None],
+            record_seen(state.seen, nxt, state.rep_penalty),
+            state.seen,
+        ),
     )
     return new_state, jnp.where(keep, nxt, -1)
 
@@ -174,6 +194,7 @@ def _admit_slot(
     temperature: jax.Array,  # f32[]
     top_k: jax.Array,  # i32[]
     top_p: jax.Array,  # f32[]
+    rep_penalty: jax.Array,  # f32[]
     key_data: jax.Array,  # u32[2] per-request PRNG key data
 ) -> SlotState:
     """Prefill one request into slot ``slot`` (compiled per T bucket)."""
@@ -197,11 +218,15 @@ def _admit_slot(
     logits, caches = forward(
         params, prompt, cfg, attn_mask=mask, kv_caches=caches, cache_offset=0
     )
+    from kubeinfer_tpu.inference.engine import seen_from_prompt
+
     last = jnp.clip(prompt_len - 1, 0, T - 1)
+    seen_row = seen_from_prompt(prompt, prompt_len[None], cfg.vocab_size)
     first = _sample_rows(
         logits[:, last], temperature[None], top_k[None], top_p[None],
-        key_data[None], prompt_len[None],
+        rep_penalty[None], seen_row, key_data[None], prompt_len[None],
     )[0]
+    seen_row = record_seen(seen_row, first[None], rep_penalty[None])
 
     def put(big, small):
         return jax.lax.dynamic_update_slice(
@@ -218,6 +243,10 @@ def _admit_slot(
         temperature=state.temperature.at[slot].set(temperature),
         top_k=state.top_k.at[slot].set(top_k),
         top_p=state.top_p.at[slot].set(top_p),
+        rep_penalty=state.rep_penalty.at[slot].set(rep_penalty),
+        seen=jax.lax.dynamic_update_slice(
+            state.seen, seen_row, (slot, 0)
+        ),
         rng=state.rng.at[slot].set(key_data),
     )
 
@@ -233,6 +262,7 @@ class _Request:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    rep_penalty: float = 1.0
     seed: int = 0
     out_tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -285,7 +315,8 @@ class ContinuousEngine:
     def submit(self, prompt: list[int], max_new_tokens: int = 32,
                eos_id: int = -1, temperature: float = 0.0,
                seed: int = 0, top_k: int = 0,
-               top_p: float = 1.0) -> _Request:
+               top_p: float = 1.0,
+               repetition_penalty: float = 1.0) -> _Request:
         if not prompt:
             raise ValueError("empty prompt")
         if not self.fits(len(prompt), max_new_tokens):
@@ -299,17 +330,19 @@ class ContinuousEngine:
             )
         req = _Request(prompt, max_new_tokens, eos_id,
                        temperature=temperature, top_k=top_k, top_p=top_p,
-                       seed=seed)
+                       rep_penalty=repetition_penalty, seed=seed)
         self._queue.put(req)
         return req
 
     def generate(self, prompt: list[int], max_new_tokens: int = 32,
                  eos_id: int = -1, temperature: float = 0.0,
                  seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                 repetition_penalty: float = 1.0,
                  timeout: float = 300.0) -> list[int]:
         req = self.submit(prompt, max_new_tokens, eos_id,
                           temperature=temperature, seed=seed,
-                          top_k=top_k, top_p=top_p)
+                          top_k=top_k, top_p=top_p,
+                          repetition_penalty=repetition_penalty)
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
@@ -362,7 +395,7 @@ class ContinuousEngine:
             self.params, self._state, jnp.asarray(padded),
             jnp.int32(len(req.prompt)), self.cfg, jnp.int32(slot),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p), key_data,
+            jnp.float32(req.top_p), jnp.float32(req.rep_penalty), key_data,
         )
         self._slot_req[slot] = req
         # the prefill already produced the first generated token

@@ -117,6 +117,68 @@ def filter_logits(
     )
 
 
+def seen_from_prompt(
+    prompt: jax.Array,  # i32[B, T] 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    vocab: int,
+) -> jax.Array:
+    """bool[B, V]: which vocab ids appear in each row's real prompt.
+    Pad columns are excluded (id 0 would otherwise always count).
+
+    Scatter-max, NOT a one-hot contraction: a [B, T, V] one-hot at
+    production vocab (152k) and a 4096 bucket is ~20GB of f32 — the
+    scatter is O(B*T) work into the O(B*V) output. It runs once per
+    generate(), so the TPU scatter-serialization cost is irrelevant.
+    """
+    B, T = prompt.shape
+    valid = jnp.arange(T)[None, :] < prompt_len[:, None]
+    return (
+        jnp.zeros((B, vocab), bool)
+        .at[jnp.arange(B)[:, None], prompt]
+        .max(valid)
+    )
+
+
+def record_seen(
+    seen: jax.Array,  # bool[B, V]
+    tokens: jax.Array,  # i32[B] newly generated ids
+    penalty: jax.Array,  # f32 broadcastable to [B]; 1.0 = disabled
+) -> jax.Array:
+    """Mark freshly generated ids as seen — behind the same disabled
+    check as the penalty itself, so penalty-free decodes don't pay a
+    [B, V] update per step."""
+
+    def update(s):
+        B = tokens.shape[0]
+        return s.at[jnp.arange(B), tokens].max(True)
+
+    return jax.lax.cond(jnp.any(penalty != 1.0), update, lambda s: s, seen)
+
+
+def apply_repetition_penalty(
+    logits: jax.Array,  # f32[B, V]
+    seen: jax.Array,  # bool[B, V] ids present in prompt or generated
+    penalty: jax.Array,  # f32 broadcastable to [B]; 1.0 = disabled
+) -> jax.Array:
+    """HF RepetitionPenaltyLogitsProcessor semantics: seen ids get
+    logit/penalty when positive, logit*penalty when negative. Runs on
+    RAW logits before temperature, and — unlike the top-k/top-p
+    filters — affects the greedy argmax too (it reshapes the
+    distribution, not just the sampling set). Behind lax.cond: disabled
+    costs nothing per step."""
+    penalty = jnp.broadcast_to(jnp.asarray(penalty, jnp.float32),
+                               logits.shape[:-1])
+
+    def apply(x):
+        pen = penalty[..., None]
+        adj = jnp.where(x > 0, x / pen, x * pen)
+        return jnp.where(seen, adj, x)
+
+    return jax.lax.cond(
+        jnp.any(penalty != 1.0), apply, lambda x: x, logits
+    )
+
+
 def gumbel_pick(
     raw_logits: jax.Array,
     filtered_scaled: jax.Array,
@@ -253,6 +315,7 @@ def _generate_jit(
     temperature: jax.Array,  # f32; <=0 = greedy
     top_k: jax.Array,  # i32; <1 = disabled
     top_p: jax.Array,  # f32; >=1 = disabled
+    rep_penalty: jax.Array,  # f32; 1.0 = disabled
     rng_key: jax.Array,
 ):
     B, T = prompt.shape
@@ -268,15 +331,18 @@ def _generate_jit(
         params, prompt, prompt_len, cfg, caches, prefill_chunk
     )
 
-    def sample(logits, key):
+    def sample(logits, key, seen):
+        logits = apply_repetition_penalty(logits, seen, rep_penalty)
         return gumbel_sample(logits, key, temperature, top_k, top_p)
 
+    seen = seen_from_prompt(prompt, prompt_len, cfg.vocab_size)
     k0, krest = jax.random.split(rng_key)
-    first = sample(next_logits, k0)
+    first = sample(next_logits, k0, seen)
+    seen = record_seen(seen, first, rep_penalty)
 
     # --- decode scan ----------------------------------------------------
     def step(carry, key):
-        caches, tok, offset, done = carry
+        caches, tok, offset, done, seen = carry
         step_mask = (jnp.arange(cache_len)[None, None, :] <= offset[:, None, None])
         logits, caches = forward(
             params, tok[:, None], cfg,
@@ -288,18 +354,19 @@ def _generate_jit(
             # solving each distinct prompt length as its own batch.
             cache_offset=offset[0],
         )
-        nxt = sample(logits[:, 0], key)
+        nxt = sample(logits[:, 0], key, seen)
+        seen = record_seen(seen, nxt, rep_penalty)
         newly_done = (nxt == eos_id) & (eos_id >= 0)
         nxt = jnp.where(done, eos_id, nxt)
         done = done | newly_done
-        return (caches, nxt, offset + 1, done), nxt
+        return (caches, nxt, offset + 1, done, seen), nxt
 
     done0 = (first == eos_id) & (eos_id >= 0)
     if max_new > 1:
         keys = jax.random.split(krest, max_new - 1)
-        (_, _, _, done), rest = jax.lax.scan(
+        (_, _, _, done, _), rest = jax.lax.scan(
             step,
-            (caches, first, prompt_len, done0),
+            (caches, first, prompt_len, done0, seen),
             keys,
             length=max_new - 1,
         )
@@ -366,6 +433,7 @@ class Engine:
         seed: int = 0,
         top_k: int = 0,
         top_p: float = 1.0,
+        repetition_penalty: float = 1.0,
     ) -> GenerationResult:
         """Batch generation, exact for ragged prompts.
 
@@ -399,6 +467,7 @@ class Engine:
                 jnp.float32(temperature),
                 jnp.int32(top_k),
                 jnp.float32(top_p),
+                jnp.float32(repetition_penalty),
                 # fold the group length in: identical keys across length
                 # groups would sample rows of different groups in
                 # lockstep (within a group the batch axis decorrelates)

@@ -128,10 +128,13 @@ class InferenceServer:
         temperature = float(body.get("temperature", 0.0))
         top_k = int(body.get("top_k", 0))
         top_p = float(body.get("top_p", 1.0))
+        rep_penalty = float(body.get("repetition_penalty", 1.0))
         if top_k < 0:
             raise ValueError("top_k must be >= 0 (0 disables)")
         if not (0.0 < top_p <= 1.0):
             raise ValueError("top_p must be in (0, 1]")
+        if rep_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0")
         seed = int(body.get("seed", 0))
         eos_id = -1
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
@@ -140,6 +143,10 @@ class InferenceServer:
         if (
             self.speculative is not None
             and temperature <= 0
+            # repetition penalty reshapes the target argmax per step
+            # using generated-token state the speculative verifier does
+            # not track; such requests take the normal paths
+            and rep_penalty == 1.0
             and self.speculative.fits(len(ids), max_tokens)
         ):
             # a configured draft model routes GREEDY requests through
@@ -167,12 +174,14 @@ class InferenceServer:
                 ids, max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p,
+                repetition_penalty=rep_penalty,
             )
         else:
             out = self.engine.generate(
                 [ids], max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p,
+                repetition_penalty=rep_penalty,
             )
             gen = out.tokens[0, : out.lengths[0]].tolist()
         # "stop" iff the sequence actually terminated on EOS — including

@@ -138,3 +138,63 @@ class TestSamplingBehavior:
         np.testing.assert_array_equal(
             np.asarray(t), np.asarray(jnp.argmax(x, -1))
         )
+
+
+class TestRepetitionPenalty:
+    def test_unit_semantics(self):
+        from kubeinfer_tpu.inference.engine import apply_repetition_penalty
+
+        x = jnp.asarray([[2.0, -1.0, 0.5, -3.0]], jnp.float32)
+        seen = jnp.asarray([[True, True, False, False]])
+        y = np.asarray(
+            apply_repetition_penalty(x, seen, jnp.float32(2.0))
+        )
+        # seen positive halves, seen negative doubles, unseen untouched
+        np.testing.assert_allclose(y, [[1.0, -2.0, 0.5, -3.0]])
+
+    def test_disabled_is_identity(self):
+        from kubeinfer_tpu.inference.engine import apply_repetition_penalty
+
+        x = _logits(8)
+        seen = jnp.ones(x.shape, bool)
+        y = apply_repetition_penalty(x, seen, jnp.float32(1.0))
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_strong_penalty_blocks_immediate_repeats_greedy(self):
+        # with an overwhelming penalty a greedy decode can never emit
+        # the same token twice (every emitted id's logit is crushed)
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = Engine(params, TINY)
+        out = eng.generate(
+            [[9, 9, 9]], max_new_tokens=12, repetition_penalty=1e9
+        )
+        toks = out.tokens[0].tolist()
+        assert len(set(toks)) == len(toks), toks
+        assert 9 not in toks  # prompt ids count as seen
+
+    def test_penalty_one_matches_plain_greedy(self):
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = Engine(params, TINY)
+        ref = eng.generate([[1, 2, 3]], max_new_tokens=8)
+        got = eng.generate(
+            [[1, 2, 3]], max_new_tokens=8, repetition_penalty=1.0
+        )
+        np.testing.assert_array_equal(got.tokens, ref.tokens)
+
+    def test_continuous_matches_engine_greedy_with_penalty(self):
+        from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        eng = Engine(params, TINY)
+        cont = ContinuousEngine(params, TINY, n_slots=2, cache_len=64)
+        cont.start()
+        try:
+            ref = eng.generate(
+                [[5, 6, 7]], max_new_tokens=6, repetition_penalty=1.7
+            )
+            got = cont.generate(
+                [5, 6, 7], max_new_tokens=6, repetition_penalty=1.7
+            )
+            assert got == ref.tokens[0].tolist()
+        finally:
+            cont.stop()
