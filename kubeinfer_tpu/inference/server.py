@@ -31,11 +31,41 @@ import logging
 import signal
 import sys
 import threading
+import time
 from http.server import ThreadingHTTPServer
 
+from kubeinfer_tpu.metrics.registry import Counter, Histogram, Registry
 from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler
 
 log = logging.getLogger(__name__)
+
+
+def _serving_metrics(registry: Registry):
+    """Serving-side collectors (vLLM exposes the equivalents; the
+    control plane's collector set lives in metrics/registry.py — these
+    are per-inference-server and ride its own /metrics endpoint)."""
+    return {
+        "requests": Counter(
+            "kubeinfer_inference_requests_total",
+            "Completion requests by outcome and decode route",
+            labels=("route", "outcome"), registry=registry,
+        ),
+        "prompt_tokens": Counter(
+            "kubeinfer_inference_prompt_tokens_total",
+            "Prompt tokens received", registry=registry,
+        ),
+        "completion_tokens": Counter(
+            "kubeinfer_inference_completion_tokens_total",
+            "Tokens generated", registry=registry,
+        ),
+        "latency": Histogram(
+            "kubeinfer_inference_request_seconds",
+            "End-to-end completion latency",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0),
+            labels=("route",), registry=registry,
+        ),
+    }
 
 
 class InferenceServer:
@@ -47,6 +77,8 @@ class InferenceServer:
         self.speculative = speculative  # SpeculativeEngine | None
         self.model_id = model_id
         self.tokenizer = tokenizer
+        self.registry = Registry()
+        self.metrics = _serving_metrics(self.registry)
         server = self
 
         class Handler(BaseEndpointHandler):
@@ -54,6 +86,14 @@ class InferenceServer:
                 path = self.path.split("?", 1)[0]
                 if path == "/health":
                     self.respond(200, "text/plain", "OK")
+                elif path == "/metrics":
+                    # unauthenticated by design: the inference server
+                    # binds inside the pod network; the manager's
+                    # token-guarded endpoint is the cluster-facing one
+                    self.respond(
+                        200, "text/plain; version=0.0.4",
+                        server.registry.render(),
+                    )
                 elif path == "/v1/models":
                     self.respond(200, "application/json", json.dumps({
                         "object": "list",
@@ -74,7 +114,14 @@ class InferenceServer:
                     self.respond(404, "text/plain", "not found\n")
                     return
                 try:
-                    body = json.loads(raw or b"{}")
+                    try:
+                        body = json.loads(raw or b"{}")
+                    except ValueError:
+                        # malformed JSON never reaches complete(); count
+                        # it here or a flood of garbage 400s shows zero
+                        # in requests_total
+                        server.metrics["requests"].inc("invalid", "invalid")
+                        raise
                     resp = server.complete(body)
                     self.respond(200, "application/json", json.dumps(resp))
                 except ValueError as e:
@@ -118,6 +165,33 @@ class InferenceServer:
         return self.tokenizer.decode(ids)
 
     def complete(self, body: dict) -> dict:
+        # mutable holder: _complete records the chosen route the moment
+        # it picks one, so exceptions thrown DURING generation still
+        # carry their route label (a local set via the return tuple
+        # would be lost exactly when the per-route error breakdown
+        # matters)
+        route_box = {"route": "invalid"}
+        t0 = time.perf_counter()
+        try:
+            resp = self._complete(body, route_box)
+        except ValueError:
+            self.metrics["requests"].inc(route_box["route"], "invalid")
+            raise
+        except Exception:
+            self.metrics["requests"].inc(route_box["route"], "error")
+            raise
+        route = route_box["route"]
+        self.metrics["requests"].inc(route, "ok")
+        self.metrics["latency"].observe(route, time.perf_counter() - t0)
+        self.metrics["prompt_tokens"].inc(
+            by=resp["usage"]["prompt_tokens"]
+        )
+        self.metrics["completion_tokens"].inc(
+            by=resp["usage"]["completion_tokens"]
+        )
+        return resp
+
+    def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")
         if prompt is None:
             raise ValueError("'prompt' is required")
@@ -156,6 +230,7 @@ class InferenceServer:
             # implemented), so sampled requests take the normal paths,
             # and requests within the target's context but beyond the
             # k+1 speculation slack fall through rather than fail
+            route_box["route"] = "speculative"
             out = self.speculative.generate(
                 [ids], max_new_tokens=max_tokens, eos_id=eos_id
             )
@@ -170,6 +245,7 @@ class InferenceServer:
             # serializing. Requests beyond slot width (long context) fall
             # through to the per-request engine, which serves the model's
             # full context.
+            route_box["route"] = "continuous"
             gen = self.continuous.generate(
                 ids, max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
@@ -177,6 +253,7 @@ class InferenceServer:
                 repetition_penalty=rep_penalty,
             )
         else:
+            route_box["route"] = "engine"
             out = self.engine.generate(
                 [ids], max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,

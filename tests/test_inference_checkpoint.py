@@ -67,3 +67,25 @@ def test_resume_training_continues(tmp_path):
 def test_restore_missing_path_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         restore_checkpoint(str(tmp_path / "nope"))
+
+
+@pytest.mark.parametrize("family_kw", [
+    {"qkv_bias": True},
+    {"num_local_experts": 4, "num_experts_per_tok": 2},
+])
+def test_roundtrip_qwen2_and_mixtral_trees(tmp_path, family_kw):
+    # family-specific param subtrees (biases / the nested moe dict) must
+    # survive the save/restore template derivation
+    from kubeinfer_tpu.inference import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, **family_kw,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    save_checkpoint(str(tmp_path / "ck"), params, cfg, step=7)
+    restored, rcfg, step = restore_checkpoint(str(tmp_path / "ck"))
+    assert step == 7
+    assert rcfg == cfg
+    assert_trees_equal(params, restored)

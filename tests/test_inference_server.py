@@ -196,3 +196,62 @@ class TestSpeculativeServing:
         assert code == 200
         assert len(resp["choices"][0]["tokens"]) >= 1
         assert srv.speculative.last_stats is None  # path not taken
+
+
+class TestServingMetrics:
+    def test_metrics_endpoint_counts_requests(self, server):
+        code, _ = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": [1, 2, 3], "max_tokens": 3},
+        )
+        assert code == 200
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert 'kubeinfer_inference_requests_total{route="engine",outcome="ok"}' in body
+        assert "kubeinfer_inference_completion_tokens_total" in body
+        assert "kubeinfer_inference_request_seconds_bucket" in body
+
+    def test_invalid_requests_counted(self, server):
+        before = server.metrics["requests"].value("invalid", "invalid")
+        code, _ = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": [1], "max_tokens": 2, "top_p": 7.0},
+        )
+        assert code == 400
+        assert server.metrics["requests"].value("invalid", "invalid") == before + 1
+
+    def test_generation_errors_carry_route_label(self, server, monkeypatch):
+        # an engine failure AFTER route selection must be counted under
+        # that route, not the "invalid" sentinel (r2 review finding)
+        def boom(*a, **kw):
+            raise RuntimeError("engine exploded")
+
+        monkeypatch.setattr(server.engine, "generate", boom)
+        before = server.metrics["requests"].value("engine", "error")
+        code, _ = post(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            {"prompt": [1, 2], "max_tokens": 2},
+        )
+        assert code == 500
+        assert server.metrics["requests"].value("engine", "error") == before + 1
+
+    def test_malformed_json_counted(self, server):
+        import urllib.request
+
+        before = server.metrics["requests"].value("invalid", "invalid")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=b"{not json", method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            code = 200
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+        assert server.metrics["requests"].value("invalid", "invalid") == before + 1
