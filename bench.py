@@ -79,7 +79,13 @@ def time_backend(backend, req, reps):
 
 
 def _chained_solver(req, k):
-    """jit fn running k data-dependent solves in ONE dispatch."""
+    """jit fn running k data-dependent solves in ONE dispatch.
+
+    Applies the same host-side priority sort JaxBackend.solve applies
+    before packing (backends.py), so the measured device work matches
+    the production solve path — the solver's per-J-tile early-out needs
+    fence classes contiguous along the job axis to skip tiles.
+    """
     import jax
     import jax.numpy as jnp
     from dataclasses import replace
@@ -87,12 +93,13 @@ def _chained_solver(req, k):
     from kubeinfer_tpu.solver.core import solve_greedy
     from kubeinfer_tpu.solver.problem import encode_problem_arrays
 
+    perm = np.argsort(-req.job_priority, kind="stable")
     p = encode_problem_arrays(
-        job_gpu=req.job_gpu,
-        job_mem_gib=req.job_mem_gib,
-        job_priority=req.job_priority,
-        job_gang=req.job_gang,
-        job_model=req.job_model,
+        job_gpu=req.job_gpu[perm],
+        job_mem_gib=req.job_mem_gib[perm],
+        job_priority=req.job_priority[perm],
+        job_gang=req.job_gang[perm] if req.job_gang is not None else None,
+        job_model=req.job_model[perm],
         node_gpu_free=req.node_gpu_free,
         node_mem_free_gib=req.node_mem_free_gib,
         node_cached=req.node_cached,

@@ -320,6 +320,7 @@ def _dense_accept(
     mem_free: jax.Array,
     num_nodes: int,
     accept_reduce=_accept_reduce_jnp,
+    accept_flags=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scatter- and sort-free per-node conflict resolution.
 
@@ -367,19 +368,26 @@ def _dense_accept(
     # is three [J]-from-[N] gathers per accept pass; TPU lowers those to
     # serialized dynamic-slice loops (measured ~0.53ms/round at 12288x1024,
     # 70% of the whole round). One fused [N, J] broadcast-compare + any()
-    # costs ~25us on the VPU instead. Winner identity rides the reduced
-    # key itself: win_key[n] == accept_key[j] iff j won node n (the key
+    # on the VPU instead (the ``accept_flags`` Pallas twin additionally
+    # skips bidder-free J tiles). Winner identity rides the reduced key
+    # itself: win_key[n] == accept_key[j] iff j won node n (the key
     # embeds the job index, so it is single-valued per job).
-    n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
-    mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel matches none
-    accept = jnp.any(
-        mine
-        & (
-            fits_all[:, None]
-            | (fits_win[:, None] & (win_key[:, None] == accept_key[None, :]))
-        ),
-        axis=0,
-    )
+    if accept_flags is not None:
+        accept = accept_flags(choice, accept_key, fits_all, fits_win, win_key)
+    else:
+        n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
+        mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel: none
+        accept = jnp.any(
+            mine
+            & (
+                fits_all[:, None]
+                | (
+                    fits_win[:, None]
+                    & (win_key[:, None] == accept_key[None, :])
+                )
+            ),
+            axis=0,
+        )
     return accept, used_gpu, used_mem
 
 
@@ -546,21 +554,31 @@ def solve_greedy(
 
         interp = accel == "interpret"
 
-        def round_bids(u, gf, mf, rankf_eff, minrank):
+        def round_bids(u, gf, mf, rankf_eff, minrank, active_j):
+            alias, act = pk.tile_activity(active_j, J)
             return pk.bid_reduce_pallas(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
-                minrank, jobs.current_node,
+                minrank, jobs.current_node, alias, act,
                 q_lo=q_lo, q_scale=q_scale, q_max=q_max,
                 node_idx_bits=node_idx_bits, interpret=interp,
             )
 
         def accept_reduce(choice, key, d, md, num_nodes):
+            _, act = pk.tile_activity(choice != num_nodes, J)
             return pk.accept_reduce_pallas(
-                choice, key, d, md, num_nodes, interpret=interp
+                choice, key, d, md, num_nodes, act, interpret=interp
+            )
+
+        def accept_flags(choice, key, fits_all, fits_win, win_key):
+            _, act = pk.tile_activity(choice != N, J)
+            return pk.accept_flags_pallas(
+                choice, key, fits_all, fits_win, win_key, act,
+                interpret=interp,
             )
     else:
 
-        def round_bids(u, gf, mf, rankf_eff, minrank):
+        def round_bids(u, gf, mf, rankf_eff, minrank, active_j):
+            del active_j  # jnp path evaluates densely (same values)
             return _round_bids_jnp(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
                 minrank, jobs.current_node, N,
@@ -568,6 +586,7 @@ def solve_greedy(
             )
 
         accept_reduce = _accept_reduce_jnp
+        accept_flags = None
 
     def run_rounds(assigned, gpu_free, mem_free, rounds0, rankf_base,
                    round_cap):
@@ -594,13 +613,30 @@ def solve_greedy(
                 gpu_free, mem_free, jobs.gpu_demand, jobs.mem_demand,
                 rankf_eff,
             )
-            prim, alt = round_bids(u, gpu_free, mem_free, rankf_eff, minrank)
+            # Conservative superset of jobs that can produce a non-BIG bid
+            # this round: the fence admits rank r on SOME node only when
+            # r <= max finite minrank, and incumbents may always bid home.
+            # Everything outside this set yields all-BIG bid panels, so
+            # the Pallas path skips their J tiles (compute AND the S DMA)
+            # with bit-identical output. -1 fallback when no node has a
+            # finite fence (nothing unplaced is feasible anywhere): only
+            # home bidders can act.
+            max_minrank = jnp.max(
+                jnp.where(minrank < RANK_INF * 0.5, minrank, -1.0)
+            )
+            active_j = (rankf_eff < RANK_INF * 0.5) & (
+                (rankf_eff <= max_minrank) | (jobs.current_node >= 0)
+            )
+            prim, alt = round_bids(
+                u, gpu_free, mem_free, rankf_eff, minrank, active_j
+            )
             has1 = prim != BIG
             choice1 = jnp.where(has1, prim & node_mask, N)
 
             accept1, used_g1, used_m1 = _dense_accept(
                 choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
                 gpu_free, mem_free, N, accept_reduce=accept_reduce,
+                accept_flags=accept_flags,
             )
             assigned = jnp.where(accept1, choice1, assigned)
             gpu_free = gpu_free - used_g1
@@ -627,6 +663,7 @@ def solve_greedy(
             accept2, used_g2, used_m2 = _dense_accept(
                 choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
                 gpu_free, mem_free, N, accept_reduce=accept_reduce,
+                accept_flags=accept_flags,
             )
             assigned = jnp.where(accept2, choice2, assigned)
             # Progress: any bid implies >=1 accept (a contested node's

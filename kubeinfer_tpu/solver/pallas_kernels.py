@@ -9,17 +9,34 @@ defeat reduction fusion. Here each round becomes:
 - ONE ``bid`` kernel: tiles the resident [N, J] cost field S through VMEM
   (TILE_N=128 sublanes x TILE_J lanes), fusing feasibility, the per-node
   priority fence, static-bound cost quantization, and the packed
-  (cost | node) i32 min — S is read from HBM exactly once per round and
+  (cost | node) i32 min — S is read from HBM at most once per round and
   nothing [N, J]-sized is ever written back. The J axis is tiled so VMEM
-  holds at most [128, 4096] f32 (4MB double-buffered) regardless of the
-  job bucket — the 50k-job soak shape would otherwise blow the 16MB VMEM
-  scoped limit. The fence minimum over ALL jobs (``minrank``) therefore
-  arrives as an input (it only reads vectors; the caller computes it as a
-  fused jnp reduction).
+  holds at most [128, 1024] f32 per block regardless of the job bucket —
+  the 50k-job soak shape would otherwise blow the 16MB VMEM scoped limit.
+  The fence minimum over ALL jobs (``minrank``) therefore arrives as an
+  input (it only reads vectors; the caller computes it as a fused jnp
+  reduction).
 - TWO ``accept`` kernel calls (first chance + second chance): per-node
   column reductions (bidder demand totals + fused-key winner) whose inputs
   are four [J] vectors; the [TILE_N, TILE_J] broadcast lives only in VMEM,
   accumulating across J tiles (innermost grid dim, init at tile 0).
+- ONE ``accept flags`` kernel per accept call: the per-job accept bit
+  (``core._dense_accept``'s [N, J] broadcast-compare + any), which under
+  plain XLA is a second full [N, J] VPU pass per accept.
+
+Per-J-tile early-out (the round-3 speedup): every kernel takes a
+scalar-prefetched per-tile activity vector. The priority fence means only
+one fence class (~1/4 of jobs, when the backend priority-sorts the job
+axis) can bid in any round, and late rounds are straggler tails of a few
+hundred jobs — so most J tiles provably produce no bids (all-BIG output /
+zero accept contribution). Inactive tiles skip their compute, and the bid
+kernel also skips the S HBM read itself: its S BlockSpec index_map routes
+an inactive tile to the previous active tile's block, and Mosaic's
+pipeline elides the DMA when consecutive grid steps map to the same block
+(measured on v5e: 11/12 tiles aliased -> ~8x less bid-kernel time).
+Activity is computed from the same fence/placed vectors the kernels
+already consume, so skipping is bit-identical to the dense evaluation
+(an inactive tile's jobs all fail the in-kernel ``allowed`` mask anyway).
 
 The jnp reference implementations live in ``core.py`` (`_round_bids_jnp`,
 `_accept_reduce_jnp`) and remain the code path for CPU tests, sharded
@@ -41,7 +58,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 128
-MAX_TILE_J = 4096  # [128, 4096] f32 = 2MB/block, 4MB double-buffered
+# 1024 measured best on v5e: full HBM bandwidth on the S sweep (746GB/s vs
+# 521GB/s at 512 — per-grid-step overhead bites below 1024) while keeping
+# the early-out granularity fine enough that one fence class spans ~3 of
+# 12 tiles at the 12288-job bucket.
+MAX_TILE_J = 1024
 # Plain Python scalars: module-level jnp constants would be captured by the
 # kernel closures, which pallas_call rejects ("captures constants"). Packed
 # values are non-negative int32 (i31): Mosaic has no unsigned reductions.
@@ -53,17 +74,41 @@ RANK_INF = 1e9
 
 
 def _tile_j(J: int) -> int:
-    """Largest J tile that divides the bucket (buckets are 128-aligned;
-    >4096 buckets are all multiples of 2048)."""
+    """Largest J tile <= MAX_TILE_J that divides the bucket (buckets are
+    128-aligned)."""
     if J <= MAX_TILE_J:
         return J
-    for t in (MAX_TILE_J, 3072, 2048, 1536, 1024, 512, 384, 256, 128):
+    for t in (MAX_TILE_J, 768, 512, 384, 256, 128):
         if J % t == 0:
             return t
     raise ValueError(f"no J tile divides {J}")
 
 
+def tile_activity(
+    active_j: jax.Array,  # bool[J] "this job may produce a bid"
+    J: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-J-tile (alias, act) vectors for the scalar-prefetch early-out.
+
+    ``act[t]`` is 1 iff any job in tile t is active. ``alias[t]`` is the
+    S-block index the bid kernel should load for tile t: t itself when
+    active, else the nearest active tile at or before t (falling back to
+    0 for a leading inactive run) — consecutive grid steps then map to
+    the same block and Mosaic skips the DMA entirely.
+    """
+    tj = _tile_j(J)
+    tiles = J // tj
+    act = jnp.any(active_j.reshape(tiles, tj), axis=1)
+    t_iota = jnp.arange(tiles, dtype=jnp.int32)
+    alias = jnp.maximum(
+        jax.lax.cummax(jnp.where(act, t_iota, jnp.int32(-1))), 0
+    )
+    return alias.astype(jnp.int32), act.astype(jnp.int32)
+
+
 def _bid_kernel(
+    alias_ref,  # i32[tiles_j] scalar-prefetch: S block to load per tile
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has potential bidders
     d_ref,  # [1, TILE_J] f32 gpu demand
     md_ref,  # [1, TILE_J] f32 mem demand
     rankf_ref,  # [1, TILE_J] f32 fence rank, RANK_INF when may-not-bid
@@ -72,7 +117,8 @@ def _bid_kernel(
     mf_ref,  # [TILE_N, 1] f32 mem free
     u_ref,  # [TILE_N, 1] f32 live best-fit pressure
     minrank_ref,  # [TILE_N, 1] f32 per-node fence minimum (over ALL jobs)
-    s_ref,  # [TILE_N, TILE_J] f32 resident cost field tile
+    s_ref,  # [TILE_N, TILE_J] f32 resident cost field tile (aliased when
+    #         inactive — contents must not be read then)
     out_ref,  # [8, TILE_J] i32 per-16-node-group packed (cost | node) mins
     *,
     q_lo: float,
@@ -80,40 +126,56 @@ def _bid_kernel(
     q_max: float,
     node_idx_bits: int,
 ):
+    del alias_ref  # consumed by the S BlockSpec index_map only
     tn = pl.program_id(0)
+    tj = pl.program_id(1)
     big = jnp.int32(_I32MAX)
     rank_inf = jnp.float32(RANK_INF)
-    d = d_ref[:]
-    md = md_ref[:]
-    rankf = rankf_ref[:]
-    gf = gf_ref[:]
-    mf = mf_ref[:]
 
-    feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, TILE_J]
-    q = jnp.clip((s_ref[:] + u_ref[:] - q_lo) * q_scale, 0.0, q_max)
-    n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
-        jnp.int32, feas.shape, 0
-    )
-    # Per-node priority fence: bid only if no higher-priority unplaced job
-    # finds this node feasible anywhere in [0, J). RANK_INF rows drop out.
-    # Incumbents are exempt on their OWN node (core._round_bids_jnp twin).
-    is_home = cur_ref[:] == n_glob
-    allowed = (
-        feas
-        & ((rankf <= minrank_ref[:]) | is_home)
-        & (rankf < rank_inf * 0.5)
-    )
-    packed = jnp.where(
-        allowed,
-        (q.astype(jnp.int32) << node_idx_bits) | n_glob,
-        big,
-    )
-    # Eight 16-node group mins per tile: the TPU output block needs >= 8
-    # sublanes anyway, and finer groups give the second-chance pass better
-    # alternates. Even a single-tile problem (N=128) has 7 other groups.
-    out_ref[:] = jnp.min(
-        packed.reshape(8, TILE_N // 8, packed.shape[1]), axis=1
-    )
+    # Inactive tile: every job in it fails the `allowed` mask below (its
+    # rank exceeds every node's fence minimum and it has no home-bid
+    # exemption — see core's activity rule), so the dense result is
+    # all-BIG. Emit that directly; the S block under s_ref is an aliased
+    # stand-in whose DMA the pipeline already skipped.
+    @pl.when(act_ref[tj] == 0)
+    def _inactive():
+        out_ref[:] = jnp.full_like(out_ref, big)
+
+    @pl.when(act_ref[tj] != 0)
+    def _active():
+        d = d_ref[:]
+        md = md_ref[:]
+        rankf = rankf_ref[:]
+        gf = gf_ref[:]
+        mf = mf_ref[:]
+
+        feas = (d <= gf + _EPS) & (md <= mf + _EPS)  # [TILE_N, TILE_J]
+        q = jnp.clip((s_ref[:] + u_ref[:] - q_lo) * q_scale, 0.0, q_max)
+        n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
+            jnp.int32, feas.shape, 0
+        )
+        # Per-node priority fence: bid only if no higher-priority unplaced
+        # job finds this node feasible anywhere in [0, J). RANK_INF rows
+        # drop out. Incumbents are exempt on their OWN node
+        # (core._round_bids_jnp twin).
+        is_home = cur_ref[:] == n_glob
+        allowed = (
+            feas
+            & ((rankf <= minrank_ref[:]) | is_home)
+            & (rankf < rank_inf * 0.5)
+        )
+        packed = jnp.where(
+            allowed,
+            (q.astype(jnp.int32) << node_idx_bits) | n_glob,
+            big,
+        )
+        # Eight 16-node group mins per tile: the TPU output block needs
+        # >= 8 sublanes anyway, and finer groups give the second-chance
+        # pass better alternates. Even a single-tile problem (N=128) has
+        # 7 other groups.
+        out_ref[:] = jnp.min(
+            packed.reshape(8, TILE_N // 8, packed.shape[1]), axis=1
+        )
 
 
 def bid_reduce_pallas(
@@ -126,6 +188,9 @@ def bid_reduce_pallas(
     rankf_eff: jax.Array,  # [J] (RANK_INF when may-not-bid)
     minrank: jax.Array,  # [N] fence minimum over all jobs
     current_node: jax.Array,  # i32[J] incumbent node index, -1 = none
+    tile_alias: jax.Array,  # i32[tiles_j] S block per tile (see
+    #                         tile_activity)
+    tile_act: jax.Array,  # i32[tiles_j] 1 = tile may produce bids
     *,
     q_lo: float,
     q_scale: float,
@@ -133,11 +198,12 @@ def bid_reduce_pallas(
     node_idx_bits: int,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """One S read -> (primary, alternate) packed i32 bids per job.
+    """At most one S read -> (primary, alternate) packed i32 bids per job.
 
     The alternate is the best node outside the primary's 16-node group —
     a cross-group second choice for the solver's second-chance pass.
     Group mins match core._round_bids_jnp exactly (parity-tested).
+    Inactive J tiles (``tile_act`` 0) emit BIG without touching HBM.
     """
     N, J = s_t.shape
     if N % TILE_N or J % 128:
@@ -157,15 +223,19 @@ def bid_reduce_pallas(
     )
     # grid (tn, tj): every (tn, tj) writes a disjoint output block, so
     # grid order is free; tj innermost keeps S reads sequential per node
-    # tile.
+    # tile AND makes aliased (inactive) tiles consecutive with the active
+    # block they point at, which is what lets the pipeline elide their
+    # DMAs.
     row = pl.BlockSpec(
-        (1, tile_j), lambda tn, tj: (0, tj), memory_space=pltpu.VMEM
+        (1, tile_j), lambda tn, tj, alias, act: (0, tj),
+        memory_space=pltpu.VMEM,
     )
     col = pl.BlockSpec(
-        (TILE_N, 1), lambda tn, tj: (tn, 0), memory_space=pltpu.VMEM
+        (TILE_N, 1), lambda tn, tj, alias, act: (tn, 0),
+        memory_space=pltpu.VMEM,
     )
-    per_group = pl.pallas_call(
-        kern,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(tiles_n, tiles_j),
         in_specs=[
             row,  # d
@@ -177,16 +247,24 @@ def bid_reduce_pallas(
             col,  # u
             col,  # minrank
             pl.BlockSpec(
-                (TILE_N, tile_j), lambda tn, tj: (tn, tj),
+                (TILE_N, tile_j),
+                lambda tn, tj, alias, act: (tn, alias[tj]),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (8, tile_j), lambda tn, tj: (tn, tj), memory_space=pltpu.VMEM
+            (8, tile_j), lambda tn, tj, alias, act: (tn, tj),
+            memory_space=pltpu.VMEM,
         ),
+    )
+    per_group = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((8 * tiles_n, J), jnp.int32),
         interpret=interpret,
     )(
+        tile_alias,
+        tile_act,
         d.reshape(1, J),
         md.reshape(1, J),
         rankf_eff.reshape(1, J),
@@ -212,6 +290,7 @@ def bid_reduce_pallas(
 
 
 def _accept_kernel(
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has bidders
     ch_ref,  # [1, TILE_J] i32 chosen node (N = no bid)
     key_ref,  # [1, TILE_J] i32 accept key
     d_ref,  # [1, TILE_J] f32
@@ -223,27 +302,29 @@ def _accept_kernel(
     tn = pl.program_id(0)
     tj = pl.program_id(1)
     big = jnp.int32(_I32MAX)
-    ch = ch_ref[:]
-    key = key_ref[:]
-    n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
-        jnp.int32, (TILE_N, ch.shape[1]), 0
-    )
-    mine = ch == n_glob  # [TILE_N, TILE_J]; the N sentinel matches no node
-    tg = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
-    tm = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
-    win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
 
     # tj is the innermost grid dim: initialize at the first J tile, then
     # accumulate — the output block index is tj-independent, so Mosaic
-    # keeps it resident in VMEM across the J sweep.
+    # keeps it resident in VMEM across the J sweep. Init happens whether
+    # or not tile 0 is active; a bidder-free tile contributes zero demand
+    # and a BIG key, so skipping its broadcast-compare is exact.
     @pl.when(tj == 0)
     def _init():
-        tg_ref[:] = tg
-        tm_ref[:] = tm
-        win_ref[:] = win
+        tg_ref[:] = jnp.zeros_like(tg_ref)
+        tm_ref[:] = jnp.zeros_like(tm_ref)
+        win_ref[:] = jnp.full_like(win_ref, big)
 
-    @pl.when(tj != 0)
+    @pl.when(act_ref[tj] != 0)
     def _accum():
+        ch = ch_ref[:]
+        key = key_ref[:]
+        n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
+            jnp.int32, (TILE_N, ch.shape[1]), 0
+        )
+        mine = ch == n_glob  # [TILE_N, TILE_J]; N sentinel matches no node
+        tg = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
+        tm = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
+        win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
         tg_ref[:] = tg_ref[:] + tg
         tm_ref[:] = tm_ref[:] + tm
         win_ref[:] = jnp.minimum(win_ref[:], win)
@@ -255,6 +336,7 @@ def accept_reduce_pallas(
     d: jax.Array,  # f32[J]
     md: jax.Array,  # f32[J]
     num_nodes: int,
+    tile_act: jax.Array,  # i32[tiles_j] 1 = tile has bidders
     *,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -269,16 +351,20 @@ def accept_reduce_pallas(
     tile_j = _tile_j(J)
     tiles_j = J // tile_j
     row = pl.BlockSpec(
-        (1, tile_j), lambda tn, tj: (0, tj), memory_space=pltpu.VMEM
+        (1, tile_j), lambda tn, tj, act: (0, tj), memory_space=pltpu.VMEM
     )
     col_out = pl.BlockSpec(
-        (TILE_N, 1), lambda tn, tj: (tn, 0), memory_space=pltpu.VMEM
+        (TILE_N, 1), lambda tn, tj, act: (tn, 0), memory_space=pltpu.VMEM
     )
-    tg, tm, win = pl.pallas_call(
-        _accept_kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(tiles_n, tiles_j),
         in_specs=[row, row, row, row],
         out_specs=[col_out, col_out, col_out],
+    )
+    tg, tm, win = pl.pallas_call(
+        _accept_kernel,
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
             jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
@@ -286,9 +372,102 @@ def accept_reduce_pallas(
         ],
         interpret=interpret,
     )(
+        tile_act,
         choice.reshape(1, J),
         accept_key.reshape(1, J),
         d.reshape(1, J),
         md.reshape(1, J),
     )
     return tg[:, 0], tm[:, 0], win[:, 0]
+
+
+def _accept_flags_kernel(
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has bidders
+    ch_ref,  # [1, TILE_J] i32 chosen node (N = no bid)
+    key_ref,  # [1, TILE_J] i32 accept key
+    all_ref,  # [TILE_N, 1] i32 node accepts all bidders (fits_all)
+    winok_ref,  # [TILE_N, 1] i32 node accepts its winner (fits_win)
+    winkey_ref,  # [TILE_N, 1] i32 winning key per node
+    acc_ref,  # [1, TILE_J] i32 out: job's bid accepted
+):
+    tn = pl.program_id(1)  # inner: accumulate into the resident out block
+    tj = pl.program_id(0)
+
+    @pl.when((tn == 0) & (act_ref[tj] == 0))
+    def _inactive():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(act_ref[tj] != 0)
+    def _active():
+        ch = ch_ref[:]
+        n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
+            jnp.int32, (TILE_N, ch.shape[1]), 0
+        )
+        mine = ch == n_glob
+        ok = (all_ref[:] != 0) | (
+            (winok_ref[:] != 0) & (winkey_ref[:] == key_ref[:])
+        )
+        hit = jnp.any(mine & ok, axis=0, keepdims=True).astype(jnp.int32)
+
+        @pl.when(tn == 0)
+        def _init():
+            acc_ref[:] = hit
+
+        @pl.when(tn != 0)
+        def _accum():
+            acc_ref[:] = acc_ref[:] | hit
+
+
+def accept_flags_pallas(
+    choice: jax.Array,  # i32[J]
+    accept_key: jax.Array,  # i32[J]
+    fits_all: jax.Array,  # bool[N]
+    fits_win: jax.Array,  # bool[N]
+    win_key: jax.Array,  # i32[N]
+    tile_act: jax.Array,  # i32[tiles_j]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-job accept bit — the Pallas twin of ``core._dense_accept``'s
+    [N, J] broadcast-compare + any() (which XLA runs as a full second
+    [N, J] VPU pass per accept). Grid is (tj, tn) with tn INNER so the
+    [1, TILE_J] output block stays VMEM-resident across the node sweep
+    (accumulating across a non-innermost dim would round-trip the block
+    through HBM each step — and Pallas does not guarantee read-back of
+    prior contents for non-consecutive revisits)."""
+    J = choice.shape[0]
+    N = fits_all.shape[0]
+    if N % TILE_N or J % 128:
+        raise ValueError(
+            f"pallas round kernels need 128-aligned axes, got N={N} "
+            f"J={J}; use accel='jnp' for unaligned bucket shapes"
+        )
+    tiles_n = N // TILE_N
+    tile_j = _tile_j(J)
+    tiles_j = J // tile_j
+    row = pl.BlockSpec(
+        (1, tile_j), lambda tj, tn, act: (0, tj), memory_space=pltpu.VMEM
+    )
+    col = pl.BlockSpec(
+        (TILE_N, 1), lambda tj, tn, act: (tn, 0), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(tiles_j, tiles_n),
+        in_specs=[row, row, col, col, col],
+        out_specs=row,
+    )
+    acc = pl.pallas_call(
+        _accept_flags_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, J), jnp.int32),
+        interpret=interpret,
+    )(
+        tile_act,
+        choice.reshape(1, J),
+        accept_key.reshape(1, J),
+        fits_all.astype(jnp.int32).reshape(N, 1),
+        fits_win.astype(jnp.int32).reshape(N, 1),
+        win_key.reshape(N, 1),
+    )
+    return acc[0] != 0

@@ -469,7 +469,8 @@ class TestPallasParity:
         accept kernel's init-at-tj0/accumulate-across-tj logic must be
         bit-identical to the untiled jnp reference. MAX_TILE_J is patched
         small so the multi-tile path runs at test-sized shapes (in
-        production it only engages at J > 4096 on real TPUs)."""
+        production it engages at any bucket over 1024 jobs — the common
+        case)."""
         import numpy as np
         from kubeinfer_tpu.solver import pallas_kernels as pk
         from kubeinfer_tpu.solver.core import solve_greedy
