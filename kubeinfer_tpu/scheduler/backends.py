@@ -261,28 +261,26 @@ class JaxBackend(SchedulerBackend):
             if np.any(pr[1:] > pr[:-1]):  # not already descending
                 perm = np.argsort(-pr, kind="stable")
 
-        def jview(a):
-            if a is None or perm is None:
-                return a
-            return np.ascontiguousarray(np.asarray(a)[perm])
-
         # Single-buffer packing: the whole problem ships in ONE transfer
         # and unpacks with free slices/bitcasts inside the jitted solve —
         # per-field device_puts cost more than the solve itself under a
-        # remote PJRT attachment (see problem.py packing layout).
+        # remote PJRT attachment (see problem.py packing layout). The
+        # priority permutation is applied inside the padding copies
+        # (job_perm) rather than as a separate pass per field.
         buf, _, _, J, N = pack_problem_arrays(
-            job_gpu=jview(req.job_gpu),
-            job_mem_gib=jview(req.job_mem_gib),
-            job_priority=jview(req.job_priority),
-            job_gang=jview(req.job_gang),
-            job_model=jview(req.job_model),
-            job_current_node=jview(req.job_current_node),
+            job_gpu=req.job_gpu,
+            job_mem_gib=req.job_mem_gib,
+            job_priority=req.job_priority,
+            job_gang=req.job_gang,
+            job_model=req.job_model,
+            job_current_node=req.job_current_node,
             node_gpu_free=req.node_gpu_free,
             node_mem_free_gib=req.node_mem_free_gib,
             node_gpu_capacity=req.node_gpu_capacity,
             node_mem_capacity_gib=req.node_mem_capacity_gib,
             node_topology=req.node_topology,
             node_cached=req.node_cached,
+            job_perm=perm,
         )
         t_encode = time.perf_counter()
         with _profile_ctx():

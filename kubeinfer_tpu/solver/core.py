@@ -292,23 +292,34 @@ def _accept_reduce_jnp(
     gpu_demand: jax.Array,
     mem_demand: jax.Array,
     num_nodes: int,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-node (gpu total, mem total, winner key) over bidders.
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-node (gpu total, mem total, winner key, winner gpu, winner mem)
+    over bidders.
 
     Column reductions over an on-the-fly ``choice[j] == n`` broadcast whose
     inputs are [J]/[N] VECTORS. This is deliberately NOT jax.ops.segment_*
     (XLA lowers those to scatters, which TPUs serialize — measured
     ~2.1ms/round at 12288x1024, the whole budget) and NOT a sort
-    (log^2-depth bitonic stages, ~0.8ms/round). The Pallas twin is
-    ``pallas_kernels.accept_reduce_pallas``.
+    (log^2-depth bitonic stages, ~0.8ms/round). Winner demands come from
+    unpacking the job index embedded in the reduced key — one [N]-from-[J]
+    gather, acceptable on the CPU/sharded paths this serves; the Pallas
+    twin ``pallas_kernels.accept_reduce_pallas`` tracks them inside the
+    reduction instead (the gather cost ~15us/accept on TPU).
     """
+    J = choice.shape[0]
+    idx_bits = max((J - 1).bit_length(), 1)
+    idx_mask = jnp.int32((1 << idx_bits) - 1)
     n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
     mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel matches none
     tot_gpu = jnp.sum(jnp.where(mine, gpu_demand[None, :], 0.0), axis=1)
     tot_mem = jnp.sum(jnp.where(mine, mem_demand[None, :], 0.0), axis=1)
     big = jnp.int32(0x7FFFFFFF)
     win_key = jnp.min(jnp.where(mine, accept_key[None, :], big), axis=1)
-    return tot_gpu, tot_mem, win_key
+    has_win = win_key != big
+    win_j = jnp.where(has_win, win_key & idx_mask, J - 1)
+    win_gpu = jnp.where(has_win, gpu_demand[win_j], 0.0)
+    win_mem = jnp.where(has_win, mem_demand[win_j], 0.0)
+    return tot_gpu, tot_mem, win_key, win_gpu, win_mem
 
 
 def _dense_accept(
@@ -319,8 +330,9 @@ def _dense_accept(
     gpu_free: jax.Array,  # f32[N]
     mem_free: jax.Array,
     num_nodes: int,
-    accept_reduce=_accept_reduce_jnp,
+    accept_reduce=None,
     accept_flags=None,
+    tile_act=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scatter- and sort-free per-node conflict resolution.
 
@@ -334,27 +346,25 @@ def _dense_accept(
     then job index for single-valuedness);
     losers immediately retry their alternate node in the caller's
     second-chance pass and re-bid next round after that. The winner's
-    demand is recovered by unpacking the job index from the reduced key —
-    no gather chain back through [J].
+    demand comes out of ``accept_reduce`` alongside the key — no gather
+    chain back through [J] on the accelerated path.
 
     The winner must still fit the CURRENT free capacity (``fits_win``):
     bids are made against round-start capacities, but the second-chance
     pass calls this with post-first-pass capacities, where a round-start-
     feasible bid can exceed what's left.
     """
-    J = choice.shape[0]
-    idx_bits = max((J - 1).bit_length(), 1)
-    idx_mask = jnp.int32((1 << idx_bits) - 1)
-
-    tot_gpu, tot_mem, win_key = accept_reduce(
-        choice, accept_key, gpu_demand, mem_demand, num_nodes
-    )
+    if accept_reduce is None:
+        tot_gpu, tot_mem, win_key, win_gpu, win_mem = _accept_reduce_jnp(
+            choice, accept_key, gpu_demand, mem_demand, num_nodes
+        )
+    else:
+        tot_gpu, tot_mem, win_key, win_gpu, win_mem = accept_reduce(
+            choice, accept_key, gpu_demand, mem_demand, num_nodes, tile_act
+        )
     fits_all = (tot_gpu <= gpu_free + _EPS) & (tot_mem <= mem_free + _EPS)
 
     has_win = win_key != jnp.int32(0x7FFFFFFF)
-    win_j = jnp.where(has_win, win_key & idx_mask, J - 1)
-    win_gpu = jnp.where(has_win, gpu_demand[win_j], 0.0)
-    win_mem = jnp.where(has_win, mem_demand[win_j], 0.0)
     fits_win = (
         has_win
         & (win_gpu <= gpu_free + _EPS)
@@ -373,7 +383,9 @@ def _dense_accept(
     # itself: win_key[n] == accept_key[j] iff j won node n (the key
     # embeds the job index, so it is single-valued per job).
     if accept_flags is not None:
-        accept = accept_flags(choice, accept_key, fits_all, fits_win, win_key)
+        accept = accept_flags(
+            choice, accept_key, fits_all, fits_win, win_key, tile_act
+        )
     else:
         n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
         mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel: none
@@ -389,6 +401,35 @@ def _dense_accept(
             axis=0,
         )
     return accept, used_gpu, used_mem
+
+
+def _prank_sorted(neg_p: jax.Array) -> jax.Array:
+    """Dense rank of a NON-DECREASING key vector: cumsum over new-distinct
+    markers. Only valid under the sortedness predicate checked by
+    solve_greedy's lax.cond; must agree with ``_prank_dense`` on every
+    sorted input (parity-tested)."""
+    first = jnp.concatenate([jnp.ones((1,), bool), neg_p[1:] != neg_p[:-1]])
+    return jnp.cumsum(first.astype(jnp.int32)) - 1
+
+
+def _prank_dense(neg_p: jax.Array) -> jax.Array:
+    """Dense rank for arbitrary order by comparison counting (see the
+    rank commentary in solve_greedy): first_occ marks one representative
+    per distinct value, so counting smaller representatives yields the
+    number of DISTINCT smaller values — the sort+cumsum dense rank."""
+    J = neg_p.shape[0]
+    j_iota = jnp.arange(J, dtype=jnp.int32)
+    first_occ = ~jnp.any(
+        (neg_p[None, :] == neg_p[:, None])
+        & (j_iota[None, :] < j_iota[:, None]),
+        axis=1,
+    )
+    return jnp.sum(
+        ((neg_p[None, :] < neg_p[:, None]) & first_occ[None, :]).astype(
+            jnp.int32
+        ),
+        axis=1,
+    )
 
 
 def _resolve_accel(accel: str, J: int, N: int) -> str:
@@ -430,24 +471,23 @@ def solve_greedy(
     # per-node priority fence below. Padded rows sort last (neg_p=+inf) and
     # get the highest ranks, but invalid jobs never bid, so they cannot
     # influence the fence.
-    # Dense rank by comparison counting, not argsort: a [J] f32 sort costs
-    # ~0.56ms at J=12288 on TPU (log^2-depth bitonic stages) plus a scatter
-    # to undo the permutation; two fused [J, J] broadcast-compare
-    # reductions cost ~0.1ms on the VPU and XLA never materializes the
-    # square. first_occ marks one representative per distinct value (the
-    # lowest index), so counting smaller representatives yields the number
-    # of DISTINCT smaller values — exactly the sort+cumsum dense rank.
+    # Two algorithms, picked at runtime by lax.cond (both produce the
+    # identical dense rank, so the choice is invisible downstream):
+    # - Sorted fast path: the backend priority-sorts the job axis before
+    #   packing (backends.py, for the per-J-tile early-out), making neg_p
+    #   non-decreasing — dense rank is then a cumsum over new-distinct
+    #   markers, pure [J] vector work.
+    # - Dense fallback (arbitrary order): comparison counting, not
+    #   argsort — a [J] f32 sort costs ~0.56ms at J=12288 on TPU
+    #   (log^2-depth bitonic stages) plus a scatter to undo the
+    #   permutation; two fused [J, J] broadcast-compare reductions cost
+    #   ~0.15ms on the VPU and XLA never materializes the square.
+    #   first_occ marks one representative per distinct value (the lowest
+    #   index), so counting smaller representatives yields the number of
+    #   DISTINCT smaller values — exactly the sort+cumsum dense rank.
     neg_p = jnp.where(jobs.valid, -jobs.priority, jnp.inf)
-    j_iota = jnp.arange(J, dtype=jnp.int32)
-    first_occ = ~jnp.any(
-        (neg_p[None, :] == neg_p[:, None]) & (j_iota[None, :] < j_iota[:, None]),
-        axis=1,
-    )
-    prank = jnp.sum(
-        ((neg_p[None, :] < neg_p[:, None]) & first_occ[None, :]).astype(
-            jnp.int32
-        ),
-        axis=1,
+    prank = lax.cond(
+        jnp.all(neg_p[1:] >= neg_p[:-1]), _prank_sorted, _prank_dense, neg_p
     )
     # The fence uses a class-compressed rank: at full resolution a node is
     # biddable only by its single highest interested priority level, and
@@ -554,8 +594,10 @@ def solve_greedy(
 
         interp = accel == "interpret"
 
-        def round_bids(u, gf, mf, rankf_eff, minrank, active_j):
-            alias, act = pk.tile_activity(active_j, J)
+        def tile_activity(active_j):
+            return pk.tile_activity(active_j, J)
+
+        def round_bids(u, gf, mf, rankf_eff, minrank, alias, act):
             return pk.bid_reduce_pallas(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
                 minrank, jobs.current_node, alias, act,
@@ -563,30 +605,47 @@ def solve_greedy(
                 node_idx_bits=node_idx_bits, interpret=interp,
             )
 
-        def accept_reduce(choice, key, d, md, num_nodes):
-            _, act = pk.tile_activity(choice != num_nodes, J)
+        # The accepts reuse the round's bid-activity tiles (threaded via
+        # _dense_accept's tile_act): bidders are a subset of bid-active
+        # jobs, and a superset activity only costs skipped-tile compute,
+        # never correctness — so the per-accept any()-reduction is saved.
+        def accept_reduce(choice, key, d, md, num_nodes, tile_act):
             return pk.accept_reduce_pallas(
-                choice, key, d, md, num_nodes, act, interpret=interp
+                choice, key, d, md, num_nodes, tile_act, interpret=interp
             )
 
-        def accept_flags(choice, key, fits_all, fits_win, win_key):
-            _, act = pk.tile_activity(choice != N, J)
+        def accept_flags(choice, key, fits_all, fits_win, win_key, tile_act):
             return pk.accept_flags_pallas(
-                choice, key, fits_all, fits_win, win_key, act,
+                choice, key, fits_all, fits_win, win_key, tile_act,
+                interpret=interp,
+            )
+
+        def fence_minrank(gf, mf, rankf_eff):
+            _, act = pk.tile_activity(rankf_eff < RANK_INF * 0.5, J)
+            return pk.fence_minrank_pallas(
+                gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff, act,
                 interpret=interp,
             )
     else:
 
-        def round_bids(u, gf, mf, rankf_eff, minrank, active_j):
-            del active_j  # jnp path evaluates densely (same values)
+        def tile_activity(active_j):
+            return None, None  # jnp path evaluates densely (same values)
+
+        def round_bids(u, gf, mf, rankf_eff, minrank, alias, act):
+            del alias, act
             return _round_bids_jnp(
                 S, u, gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff,
                 minrank, jobs.current_node, N,
                 q_lo, q_scale, q_max, node_idx_bits,
             )
 
-        accept_reduce = _accept_reduce_jnp
+        accept_reduce = None
         accept_flags = None
+
+        def fence_minrank(gf, mf, rankf_eff):
+            return _fence_minrank(
+                gf, mf, jobs.gpu_demand, jobs.mem_demand, rankf_eff
+            )
 
     def run_rounds(assigned, gpu_free, mem_free, rounds0, rankf_base,
                    round_cap):
@@ -609,10 +668,7 @@ def solve_greedy(
             # ops need no separate unassigned input.
             rankf_eff = jnp.where(assigned < 0, rankf_base, RANK_INF)
             u = v_g * gpu_free + v_m * mem_free  # [N] live best-fit pressure
-            minrank = _fence_minrank(
-                gpu_free, mem_free, jobs.gpu_demand, jobs.mem_demand,
-                rankf_eff,
-            )
+            minrank = fence_minrank(gpu_free, mem_free, rankf_eff)
             # Conservative superset of jobs that can produce a non-BIG bid
             # this round: the fence admits rank r on SOME node only when
             # r <= max finite minrank, and incumbents may always bid home.
@@ -627,8 +683,9 @@ def solve_greedy(
             active_j = (rankf_eff < RANK_INF * 0.5) & (
                 (rankf_eff <= max_minrank) | (jobs.current_node >= 0)
             )
+            alias, act = tile_activity(active_j)
             prim, alt = round_bids(
-                u, gpu_free, mem_free, rankf_eff, minrank, active_j
+                u, gpu_free, mem_free, rankf_eff, minrank, alias, act
             )
             has1 = prim != BIG
             choice1 = jnp.where(has1, prim & node_mask, N)
@@ -636,7 +693,7 @@ def solve_greedy(
             accept1, used_g1, used_m1 = _dense_accept(
                 choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
                 gpu_free, mem_free, N, accept_reduce=accept_reduce,
-                accept_flags=accept_flags,
+                accept_flags=accept_flags, tile_act=act,
             )
             assigned = jnp.where(accept1, choice1, assigned)
             gpu_free = gpu_free - used_g1
@@ -663,7 +720,7 @@ def solve_greedy(
             accept2, used_g2, used_m2 = _dense_accept(
                 choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
                 gpu_free, mem_free, N, accept_reduce=accept_reduce,
-                accept_flags=accept_flags,
+                accept_flags=accept_flags, tile_act=act,
             )
             assigned = jnp.where(accept2, choice2, assigned)
             # Progress: any bid implies >=1 accept (a contested node's
@@ -688,26 +745,54 @@ def solve_greedy(
         jnp.int32(0), rankf, jnp.int32(max_rounds),
     )
 
-    assigned, gpu_free, mem_free = _gang_repair(p, assigned)
-    # Fill pass: gang repair RETURNS capacity after the fixpoint, which
-    # can leave feasible non-gang jobs stranded (found by the property
-    # fuzz). Re-run the rounds with every unwound gang member fenced —
-    # only non-gang jobs may claim the freed capacity, so no new repair
-    # is ever needed and the non-gang fixpoint guarantee holds for the
-    # FINAL capacities. Costs one no-progress round when nothing was
-    # freed. The budget is one round per fillable job plus one: every
-    # progress round places >=1 job, so the loop reaches its fixpoint
-    # before this cap can bind (a fixed cap would silently re-strand
-    # capacity in the worst case — one freed node contested by more
-    # small jobs than the cap, settling ~1 per round).
-    rankf_fill = jnp.where(
-        (jobs.gang_id >= 0) & (assigned < 0), RANK_INF, rankf
+    # Repair + fill run only when some gang member is unplaced — the
+    # exact trigger for an unwind. When every gang is complete, repair is
+    # an identity (keep all; recomputed capacity equals the loop-tracked
+    # capacity on valid nodes) and the fill pass would just burn one
+    # no-progress round, so the cond skips ~0.2ms off the common
+    # all-placed solve with bit-identical output.
+    def _repair_and_fill(args):
+        assigned, gpu_free, mem_free, rounds = args
+        assigned, gpu_free, mem_free = _gang_repair(p, assigned)
+        # Fill pass: gang repair RETURNS capacity after the fixpoint,
+        # which can leave feasible non-gang jobs stranded (found by the
+        # property fuzz). Re-run the rounds with every unwound gang
+        # member fenced — only non-gang jobs may claim the freed
+        # capacity, so no new repair is ever needed and the non-gang
+        # fixpoint guarantee holds for the FINAL capacities. The budget
+        # is one round per fillable job plus one: every progress round
+        # places >=1 job, so the loop reaches its fixpoint before this
+        # cap can bind (a fixed cap would silently re-strand capacity in
+        # the worst case — one freed node contested by more small jobs
+        # than the cap, settling ~1 per round).
+        rankf_fill = jnp.where(
+            (jobs.gang_id >= 0) & (assigned < 0), RANK_INF, rankf
+        )
+        gf_fill = jnp.where(nodes.valid, gpu_free, -1.0)
+        fillable = (assigned < 0) & jobs.valid & (jobs.gang_id < 0)
+        assigned, gpu_free, mem_free, rounds, _ = run_rounds(
+            assigned, gf_fill, mem_free, rounds, rankf_fill,
+            rounds + jnp.sum(fillable.astype(jnp.int32)) + 1,
+        )
+        return assigned, gpu_free, mem_free, rounds
+
+    incomplete_gang = jnp.any(
+        (jobs.gang_id >= 0) & jobs.valid & (assigned < 0)
     )
-    gf_fill = jnp.where(nodes.valid, gpu_free, -1.0)
-    fillable = (assigned < 0) & jobs.valid & (jobs.gang_id < 0)
-    assigned, gpu_free, mem_free, rounds, _ = run_rounds(
-        assigned, gf_fill, mem_free, rounds, rankf_fill,
-        rounds + jnp.sum(fillable.astype(jnp.int32)) + 1,
+    # The fill must also run when the main loop exited on its round
+    # budget rather than at a fixpoint (progress still possible): the
+    # old unconditional fill rescued exactly that regime with its fresh
+    # budget, and skipping it would strand placeable jobs. A clean
+    # fixpoint exit with complete gangs is the only case where skipping
+    # is provably a no-op.
+    budget_capped = (rounds >= max_rounds) & jnp.any(
+        (assigned < 0) & jobs.valid
+    )
+    assigned, gpu_free, mem_free, rounds = lax.cond(
+        incomplete_gang | budget_capped,
+        _repair_and_fill,
+        lambda args: args,
+        (assigned, gpu_free, mem_free, rounds),
     )
     gpu_free = jnp.where(nodes.valid, gpu_free, 0.0)
     placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
@@ -716,24 +801,56 @@ def solve_greedy(
 
 def _gang_repair(p: Problem, assigned: jax.Array):
     """Unwind incompletely-placed gangs (all-or-nothing) and recompute
-    capacity from scratch. Any non-negative gang id works (membership is
-    pure equality against other rows; -1 marks non-gang).
+    capacity from scratch. Gang ids must be < 2^16 (the hi/lo byte split
+    below aliases larger ids); the pack layer's _densify_gangs guarantees
+    dense ids in [0, J) with J <= 65536. -1 marks non-gang.
 
-    Scatter-free: segment_sum lowers to scatters, which TPUs serialize
-    (measured ~0.3ms here at 12288 jobs); per-JOB gang membership counts
-    via a fused [J, J] broadcast-compare reduction skip both the scatter
-    and the complete[gid] gather-back, and the capacity recompute is the
-    same [N, J] column reduction the accept path uses.
+    Scatter-free AND [J, J]-free: segment_sum lowers to scatters, which
+    TPUs serialize (measured ~0.3ms here at 12288 jobs), and the earlier
+    [J, J] broadcast-compare membership counts cost ~0.16ms of VPU time
+    (and risk materializing ~1.2GB on CPU backends if XLA doesn't fuse —
+    advisor r2). Instead the dense id splits into hi/lo bytes and the
+    per-job counts become two narrow MXU matmuls over [J, 256] one-hots:
+      count[j] = sum_k w[k]·[gid_k == gid_j]
+               = e_hi[j]^T (OH^T (OL ∘ w)) e_lo[j]
+    — a gather-free one-hot sandwich (the same trick the cache-affinity
+    scoring uses, _static_cost_t). 0/1 products are exact in bf16 and
+    counts < 2^24 are exact in the f32 accumulator, so results are
+    bit-identical to the broadcast-compare form.
     """
     jobs, nodes = p.jobs, p.nodes
     N = nodes.valid.shape[0]
     in_gang = (jobs.gang_id >= 0) & jobs.valid
     gid = jnp.where(in_gang, jobs.gang_id, -1)
-    same = (gid[None, :] == gid[:, None]) & in_gang[None, :]  # [J, J]
-    need = jnp.sum(same.astype(jnp.int32), axis=1)
-    got = jnp.sum(
-        (same & (assigned >= 0)[None, :]).astype(jnp.int32), axis=1
-    )
+
+    hi = (gid >> 8).astype(jnp.int32)
+    lo = (gid & 255).astype(jnp.int32)
+    slots = jnp.arange(256, dtype=jnp.int32)
+    oh_hi = (
+        in_gang[:, None] & (hi[:, None] == slots[None, :])
+    ).astype(jnp.bfloat16)  # [J, 256]
+    oh_lo = (
+        in_gang[:, None] & (lo[:, None] == slots[None, :])
+    ).astype(jnp.bfloat16)
+    placed_w = (assigned >= 0).astype(jnp.bfloat16)
+    # need and got share the hi-side contraction: RHS carries both weight
+    # columns (1 for membership, placed for got) side by side.
+    rhs = jnp.concatenate([oh_lo, oh_lo * placed_w[:, None]], axis=1)
+    table = jax.lax.dot_general(
+        oh_hi, rhs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [256, 512]: [h, l] membership counts | placed counts
+    # f32 on purpose: table holds counts up to J, and bf16's 8 mantissa
+    # bits only represent integers exactly up to 256. Each output row has
+    # at most one nonzero product (oh_hi rows are one-hot), so f32 is
+    # exact.
+    back = jax.lax.dot_general(
+        oh_hi.astype(jnp.float32), table, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [J, 512]: row j holds table[hi_j, :]
+    lo_f = oh_lo.astype(jnp.float32)
+    need = jnp.sum(back[:, :256] * lo_f, axis=1).astype(jnp.int32)
+    got = jnp.sum(back[:, 256:] * lo_f, axis=1).astype(jnp.int32)
     keep = (~in_gang) | (got == need)
     assigned = jnp.where(keep, assigned, -1)
 

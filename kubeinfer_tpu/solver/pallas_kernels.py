@@ -23,6 +23,9 @@ defeat reduction fusion. Here each round becomes:
 - ONE ``accept flags`` kernel per accept call: the per-job accept bit
   (``core._dense_accept``'s [N, J] broadcast-compare + any), which under
   plain XLA is a second full [N, J] VPU pass per accept.
+- ONE ``fence`` kernel: the per-node fence minimum (``core._fence_minrank``),
+  an [N, J] feasibility broadcast + rank min — under XLA another full
+  [N, J] VPU pass per round even though its inputs are vectors.
 
 Per-J-tile early-out (the round-3 speedup): every kernel takes a
 scalar-prefetched per-tile activity vector. The priority fence means only
@@ -71,6 +74,16 @@ _EPS = 1e-4
 # Large-but-finite sentinel for "this job may not bid" (placed/invalid);
 # finite so `rank <= minrank` comparisons stay well-defined.
 RANK_INF = 1e9
+
+
+def _require_aligned(N: int, J: int) -> None:
+    """All round kernels share the same layout contract: node axis a
+    multiple of TILE_N, job axis 128-lane aligned."""
+    if N % TILE_N or J % 128:
+        raise ValueError(
+            f"pallas round kernels need 128-aligned axes, got N={N} J={J}; "
+            "use accel='jnp' for unaligned bucket shapes"
+        )
 
 
 def _tile_j(J: int) -> int:
@@ -206,11 +219,7 @@ def bid_reduce_pallas(
     Inactive J tiles (``tile_act`` 0) emit BIG without touching HBM.
     """
     N, J = s_t.shape
-    if N % TILE_N or J % 128:
-        raise ValueError(
-            f"pallas round kernels need 128-aligned axes, got N={N} J={J}; "
-            "use accel='jnp' for unaligned bucket shapes"
-        )
+    _require_aligned(N, J)
     tiles_n = N // TILE_N
     tile_j = _tile_j(J)
     tiles_j = J // tile_j
@@ -275,18 +284,9 @@ def bid_reduce_pallas(
         minrank.reshape(N, 1),
         s_t,
     )
-    prim = jnp.min(per_group, axis=0)  # [J]
-    prim_group = jnp.argmin(per_group, axis=0)
-    g_iota = jnp.arange(8 * tiles_n, dtype=jnp.int32)
-    alt = jnp.min(
-        jnp.where(
-            g_iota[:, None] == prim_group[None, :],
-            jnp.int32(_I32MAX),
-            per_group,
-        ),
-        axis=0,
+    return bid_select_pallas(
+        per_group, tile_alias, tile_act, interpret=interpret
     )
-    return prim, alt
 
 
 def _accept_kernel(
@@ -298,6 +298,8 @@ def _accept_kernel(
     tg_ref,  # [TILE_N, 1] f32 out: bidder gpu total
     tm_ref,  # [TILE_N, 1] f32 out: bidder mem total
     win_ref,  # [TILE_N, 1] i32 out: winning key
+    wd_ref,  # [TILE_N, 1] f32 out: winner's gpu demand
+    wmd_ref,  # [TILE_N, 1] f32 out: winner's mem demand
 ):
     tn = pl.program_id(0)
     tj = pl.program_id(1)
@@ -313,6 +315,8 @@ def _accept_kernel(
         tg_ref[:] = jnp.zeros_like(tg_ref)
         tm_ref[:] = jnp.zeros_like(tm_ref)
         win_ref[:] = jnp.full_like(win_ref, big)
+        wd_ref[:] = jnp.zeros_like(wd_ref)
+        wmd_ref[:] = jnp.zeros_like(wmd_ref)
 
     @pl.when(act_ref[tj] != 0)
     def _accum():
@@ -325,9 +329,20 @@ def _accept_kernel(
         tg = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
         tm = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
         win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
+        # Winner demand rides the reduction: selecting the NEW running
+        # minimum's row (winner mask) costs one extra compare + two
+        # masked sums per tile, and saves _dense_accept's [N]-from-[J]
+        # winner-demand gather on the Pallas path.
+        new_win = jnp.minimum(win_ref[:], win)
+        winner = mine & (key == new_win)
+        wd = jnp.sum(jnp.where(winner, d_ref[:], 0.0), axis=1, keepdims=True)
+        wmd = jnp.sum(jnp.where(winner, md_ref[:], 0.0), axis=1, keepdims=True)
+        take = win < win_ref[:]
         tg_ref[:] = tg_ref[:] + tg
         tm_ref[:] = tm_ref[:] + tm
-        win_ref[:] = jnp.minimum(win_ref[:], win)
+        win_ref[:] = new_win
+        wd_ref[:] = jnp.where(take, wd, wd_ref[:])
+        wmd_ref[:] = jnp.where(take, wmd, wmd_ref[:])
 
 
 def accept_reduce_pallas(
@@ -339,14 +354,11 @@ def accept_reduce_pallas(
     tile_act: jax.Array,  # i32[tiles_j] 1 = tile has bidders
     *,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-node (gpu total, mem total, winner key) over bidders."""
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-node (gpu total, mem total, winner key, winner gpu, winner mem)
+    over bidders."""
     J = choice.shape[0]
-    if num_nodes % TILE_N or J % 128:
-        raise ValueError(
-            f"pallas round kernels need 128-aligned axes, got N={num_nodes} "
-            f"J={J}; use accel='jnp' for unaligned bucket shapes"
-        )
+    _require_aligned(num_nodes, J)
     tiles_n = num_nodes // TILE_N
     tile_j = _tile_j(J)
     tiles_j = J // tile_j
@@ -360,15 +372,17 @@ def accept_reduce_pallas(
         num_scalar_prefetch=1,
         grid=(tiles_n, tiles_j),
         in_specs=[row, row, row, row],
-        out_specs=[col_out, col_out, col_out],
+        out_specs=[col_out, col_out, col_out, col_out, col_out],
     )
-    tg, tm, win = pl.pallas_call(
+    tg, tm, win, wd, wmd = pl.pallas_call(
         _accept_kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
             jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
             jax.ShapeDtypeStruct((num_nodes, 1), jnp.int32),
+            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
+            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
         ],
         interpret=interpret,
     )(
@@ -378,7 +392,163 @@ def accept_reduce_pallas(
         d.reshape(1, J),
         md.reshape(1, J),
     )
-    return tg[:, 0], tm[:, 0], win[:, 0]
+    return tg[:, 0], tm[:, 0], win[:, 0], wd[:, 0], wmd[:, 0]
+
+
+def _bid_select_kernel(
+    alias_ref,  # i32[tiles_j] scalar-prefetch: per_group block per tile
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile may hold bids
+    pg_ref,  # [G, TILE_J] i32 per-16-node-group packed mins
+    prim_ref,  # [1, TILE_J] i32 out
+    alt_ref,  # [1, TILE_J] i32 out
+):
+    del alias_ref
+    tj = pl.program_id(0)
+    big = jnp.int32(_I32MAX)
+
+    @pl.when(act_ref[tj] == 0)
+    def _inactive():
+        prim_ref[:] = jnp.full_like(prim_ref, big)
+        alt_ref[:] = jnp.full_like(alt_ref, big)
+
+    @pl.when(act_ref[tj] != 0)
+    def _active():
+        pg = pg_ref[:]
+        prim = jnp.min(pg, axis=0, keepdims=True)
+        # Exclude the primary's group by VALUE, not argmin (Mosaic has no
+        # i32 argmin): packed bids embed the node index and each group
+        # covers a disjoint 16-node range, so a non-BIG group min is
+        # globally unique per column — value exclusion selects exactly
+        # the argmin group. All-BIG columns stay BIG either way.
+        alt_ref[:] = jnp.min(
+            jnp.where(pg == prim, big, pg), axis=0, keepdims=True
+        )
+        prim_ref[:] = prim
+
+
+def bid_select_pallas(
+    per_group: jax.Array,  # i32[G, J] per-16-node-group packed mins
+    tile_alias: jax.Array,  # i32[tiles_j]
+    tile_act: jax.Array,  # i32[tiles_j]
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(primary, alternate) per job from the bid kernel's group mins.
+
+    The jnp form is three reductions (min, argmin, masked min) over the
+    same [G, J] producer — three HBM passes under XLA since per_group is
+    a materialized kernel output. One Pallas sweep reads it once, and
+    inactive J tiles (all-BIG columns) skip their read via the same
+    alias trick the bid kernel uses. Must match the tail of
+    core._round_bids_jnp bit-for-bit (parity-tested).
+    """
+    G, J = per_group.shape
+    tile_j = _tile_j(J)
+    tiles_j = J // tile_j
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(tiles_j,),
+        in_specs=[
+            pl.BlockSpec(
+                (G, tile_j), lambda tj, alias, act: (0, alias[tj]),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, tile_j), lambda tj, alias, act: (0, tj),
+                memory_space=pltpu.VMEM,
+            ),
+        ] * 2,
+    )
+    prim, alt = pl.pallas_call(
+        _bid_select_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, J), jnp.int32),
+            jax.ShapeDtypeStruct((1, J), jnp.int32),
+        ],
+        interpret=interpret,
+    )(tile_alias, tile_act, per_group)
+    return prim[0], alt[0]
+
+
+def _fence_kernel(
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has unplaced jobs
+    d_ref,  # [1, TILE_J] f32 gpu demand
+    md_ref,  # [1, TILE_J] f32 mem demand
+    rankf_ref,  # [1, TILE_J] f32 fence rank (RANK_INF = placed/invalid)
+    gf_ref,  # [TILE_N, 1] f32 gpu free
+    mf_ref,  # [TILE_N, 1] f32 mem free
+    out_ref,  # [TILE_N, 1] f32 out: per-node fence minimum
+):
+    tj = pl.program_id(1)
+    rank_inf = jnp.float32(RANK_INF)
+
+    @pl.when(tj == 0)
+    def _init():
+        out_ref[:] = jnp.full_like(out_ref, rank_inf)
+
+    # A tile whose jobs are all placed/invalid contributes only RANK_INF
+    # (its rankf rows are RANK_INF), so skipping it is exact.
+    @pl.when(act_ref[tj] != 0)
+    def _accum():
+        feas = (d_ref[:] <= gf_ref[:] + _EPS) & (md_ref[:] <= mf_ref[:] + _EPS)
+        part = jnp.min(
+            jnp.where(feas, rankf_ref[:], rank_inf), axis=1, keepdims=True
+        )
+        out_ref[:] = jnp.minimum(out_ref[:], part)
+
+
+def fence_minrank_pallas(
+    gpu_free: jax.Array,  # f32[N]
+    mem_free: jax.Array,  # f32[N]
+    gpu_demand: jax.Array,  # f32[J]
+    mem_demand: jax.Array,  # f32[J]
+    rankf_eff: jax.Array,  # f32[J] (RANK_INF = placed/invalid)
+    tile_act: jax.Array,  # i32[tiles_j] 1 = tile has unplaced jobs
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-node fence minimum — Pallas twin of ``core._fence_minrank``.
+
+    Skips J tiles whose jobs are all placed (their ranks are RANK_INF and
+    cannot lower any node's minimum). With the job axis priority-sorted,
+    placed jobs become a contiguous prefix as fence classes settle, so
+    late rounds reduce over a small suffix instead of all J.
+    """
+    N = gpu_free.shape[0]
+    J = gpu_demand.shape[0]
+    _require_aligned(N, J)
+    tiles_n = N // TILE_N
+    tile_j = _tile_j(J)
+    tiles_j = J // tile_j
+    row = pl.BlockSpec(
+        (1, tile_j), lambda tn, tj, act: (0, tj), memory_space=pltpu.VMEM
+    )
+    col = pl.BlockSpec(
+        (TILE_N, 1), lambda tn, tj, act: (tn, 0), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(tiles_n, tiles_j),
+        in_specs=[row, row, row, col, col],
+        out_specs=col,
+    )
+    out = pl.pallas_call(
+        _fence_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        interpret=interpret,
+    )(
+        tile_act,
+        gpu_demand.reshape(1, J),
+        mem_demand.reshape(1, J),
+        rankf_eff.reshape(1, J),
+        gpu_free.reshape(N, 1),
+        mem_free.reshape(N, 1),
+    )
+    return out[:, 0]
 
 
 def _accept_flags_kernel(
@@ -437,11 +607,7 @@ def accept_flags_pallas(
     prior contents for non-consecutive revisits)."""
     J = choice.shape[0]
     N = fits_all.shape[0]
-    if N % TILE_N or J % 128:
-        raise ValueError(
-            f"pallas round kernels need 128-aligned axes, got N={N} "
-            f"J={J}; use accel='jnp' for unaligned bucket shapes"
-        )
+    _require_aligned(N, J)
     tiles_n = N // TILE_N
     tile_j = _tile_j(J)
     tiles_j = J // tile_j

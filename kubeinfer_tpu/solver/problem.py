@@ -178,9 +178,15 @@ def _prep_padded_arrays(
     node_cached: np.ndarray | None = None,
     job_multiple: int = 1,
     node_multiple: int = 1,
+    job_perm: np.ndarray | None = None,
 ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int, int, int, int]:
     """Shared host-side prep: bucket, pad, densify. Returns numpy fields
-    (jobs dict, nodes dict) + (J_true, N_true, J, N)."""
+    (jobs dict, nodes dict) + (J_true, N_true, J, N).
+
+    ``job_perm`` reorders the job axis during the padding copy (one fused
+    fancy-index per field instead of a separate pre-permutation pass) —
+    the backend's priority sort uses this; see backends.py.
+    """
     J_true = int(job_gpu.shape[0])
     N_true = int(node_gpu_free.shape[0])
     J = bucket_size(max(J_true, 1))
@@ -190,7 +196,10 @@ def _prep_padded_arrays(
 
     def padj(a, fill, dtype):
         out = np.full(J, fill, dtype)
-        out[:J_true] = a
+        if job_perm is None:
+            out[:J_true] = a
+        else:
+            out[:J_true] = np.asarray(a)[job_perm]
         return out
 
     def padn(a, fill, dtype):

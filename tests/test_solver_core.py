@@ -559,3 +559,60 @@ class TestPropertyFuzz:
                     kw["job_mem_gib"][j] <= mem_left + 1e-3
                 )
                 assert not fits.any(), (seed, int(j))
+
+
+class TestPrankParity:
+    """The sorted fast path and dense fallback of the priority rank must
+    agree on every sorted input — the backend priority-sorts before
+    packing, so production solves take the sorted path exclusively while
+    most unit tests exercise the dense one; this is the bridge."""
+
+    def test_sorted_matches_dense_on_sorted_inputs(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from kubeinfer_tpu.solver.core import _prank_dense, _prank_sorted
+
+        rng = np.random.default_rng(3)
+        cases = [
+            np.sort(rng.integers(0, 8, 200).astype(np.float32)),
+            np.sort(rng.normal(size=173).astype(np.float32)),
+            np.zeros(64, np.float32),  # single class
+            np.arange(50, dtype=np.float32),  # all distinct
+            np.array([1.0], np.float32),  # J=1
+        ]
+        for neg_p in cases:
+            # padded rows (inf) always sort last, as solve_greedy builds
+            # them
+            padded = np.concatenate([neg_p, [np.inf, np.inf]])
+            got = np.asarray(_prank_sorted(jnp.asarray(padded)))
+            want = np.asarray(_prank_dense(jnp.asarray(padded)))
+            np.testing.assert_array_equal(got, want)
+
+    def test_solve_sorted_path_equals_dense_path(self):
+        """Same logical problem, sorted job order: a solve whose prank
+        comes from the sorted path must equal one where the dense path is
+        forced (by patching the sortedness predicate's branch)."""
+        import numpy as np
+        from kubeinfer_tpu.solver import core
+        from kubeinfer_tpu.solver.core import solve_greedy
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        rng = np.random.default_rng(5)
+        J, N = 200, 32
+        pr = np.sort(rng.integers(0, 6, J).astype(np.float32))[::-1].copy()
+        kw = dict(
+            job_gpu=rng.integers(1, 8, J).astype(np.float32),
+            job_mem_gib=rng.integers(4, 64, J).astype(np.float32),
+            job_priority=pr,
+            node_gpu_free=np.full(N, 32.0, np.float32),
+            node_mem_free_gib=np.full(N, 256.0, np.float32),
+        )
+        p = encode_problem_arrays(**kw)
+        a = solve_greedy(p, accel="jnp")
+        orig = core._prank_sorted
+        core._prank_sorted = core._prank_dense  # force dense either way
+        try:
+            b = solve_greedy(p, accel="jnp")
+        finally:
+            core._prank_sorted = orig
+        np.testing.assert_array_equal(np.asarray(a.node), np.asarray(b.node))
