@@ -9,6 +9,7 @@ duties, so its env surface covers node identity and capacity:
   NODE_NAME            node identity (default: hostname)
   STORE_ADDR           control-plane store URL, e.g. http://127.0.0.1:18080
   STORE_TOKEN_FILE     bearer-token file for the store (optional)
+  STORE_CA_FILE        CA bundle verifying an https store (optional)
   MODEL_PATH           model cache root (default /models, ref parity)
   GPU_CAPACITY         schedulable chip count (default 8)
   GPU_MEMORY           per-node accelerator memory, e.g. 16Gi (default 16Gi)
@@ -55,14 +56,25 @@ def main() -> int:
         log.error("STORE_ADDR is required (control-plane store URL)")
         return 2
     token_file = os.environ.get("STORE_TOKEN_FILE", "")
+    ca_file = os.environ.get("STORE_CA_FILE", "")
     token = load_token(token_file) if token_file else ""
 
     node_name = os.environ.get("NODE_NAME", socket.gethostname())
     model_root = os.environ.get("MODEL_PATH", "/models")
     gpu_capacity = float(os.environ.get("GPU_CAPACITY", "8"))
     gpu_memory = parse_quantity(os.environ.get("GPU_MEMORY", "16Gi"))
+    observe_memory = None
     if os.environ.get("AUTO_DETECT_ACCELERATORS", "0") == "1":
         from kubeinfer_tpu.agent.probe import probe_accelerators
+
+        def observe_memory():
+            i = probe_accelerators()
+            # knownness, not truthiness: free == 0 (HBM fully exhausted
+            # by an external process) is precisely the signal the solver
+            # must see (advisor r3)
+            if i is None or not i.memory_free_known:
+                return None
+            return i.memory_bytes, i.memory_free_bytes
 
         info = probe_accelerators()
         if info is not None:
@@ -92,7 +104,7 @@ def main() -> int:
             float(os.environ.get("LEASE_RETRY_S", "2")),
         )
 
-    store = RemoteStore(store_addr, token=token)
+    store = RemoteStore(store_addr, token=token, ca_file=ca_file)
     if not store.healthz():
         log.error("store %s is not reachable", store_addr)
         return 1
@@ -108,6 +120,7 @@ def main() -> int:
         downloader=downloader,
         start_runtimes=start_runtimes,
         lease_timings=lease_timings,
+        observe_memory=observe_memory,
     )
 
     stop = threading.Event()

@@ -39,12 +39,16 @@ class Follower:
         runtime_config: RuntimeConfig | None = None,
         start_runtime: bool = True,
         sync_attempts: int = 5,
+        transfer_ca_file: str = "",
     ) -> None:
         self._endpoint = coordinator_endpoint
         self.model_path = model_path
         self._runtime_config = runtime_config
         self._start_runtime = start_runtime
         self._sync_attempts = sync_attempts
+        # CA bundle for an https coordinator model endpoint (TLS model
+        # distribution); empty = plain http endpoints (the default)
+        self._transfer_ca = transfer_ca_file
         self.runtime: RuntimeServer | None = None
         self._ready = threading.Event()
 
@@ -71,7 +75,8 @@ class Follower:
         t0 = time.perf_counter()
         try:
             sync_model(
-                self._endpoint, self.model_path, attempts=self._sync_attempts
+                self._endpoint, self.model_path,
+                attempts=self._sync_attempts, ca_file=self._transfer_ca,
             )
         except TransferError:
             # Availability beats freshness — but ONLY for a provably

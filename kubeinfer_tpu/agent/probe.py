@@ -25,7 +25,11 @@ class AcceleratorInfo:
     count: int
     platform: str
     memory_bytes: int  # total HBM across local devices (0 = unknown)
-    memory_free_bytes: int  # 0 = unknown
+    memory_free_bytes: int  # meaningful only when memory_free_known
+    # free == 0 is ambiguous between "stats unavailable" and "genuinely
+    # exhausted" — and the exhausted case is exactly what the heartbeat
+    # observer must report (advisor r3), so knownness is explicit
+    memory_free_known: bool = False
 
 
 def probe_accelerators() -> AcceleratorInfo | None:
@@ -45,6 +49,7 @@ def probe_accelerators() -> AcceleratorInfo | None:
         return None
     total = 0
     free = 0
+    free_known = True
     for d in devices:
         try:
             stats = d.memory_stats() or {}
@@ -54,11 +59,13 @@ def probe_accelerators() -> AcceleratorInfo | None:
         in_use = int(stats.get("bytes_in_use", 0))
         total += limit
         free += max(limit - in_use, 0)
+        free_known = free_known and "bytes_limit" in stats
     return AcceleratorInfo(
         count=len(devices),
         platform=devices[0].platform,
         memory_bytes=total,
         memory_free_bytes=free if total else 0,
+        memory_free_known=free_known and total > 0,
     )
 
 

@@ -77,16 +77,35 @@ def _local_sha256(path: pathlib.Path) -> str:
     return h.hexdigest()
 
 
-def _open(endpoint: str) -> tuple[http.client.HTTPConnection, str]:
+def _open(
+    endpoint: str, ca_file: str = ""
+) -> tuple[http.client.HTTPConnection, str]:
     u = urllib.parse.urlparse(endpoint)
-    if u.scheme != "http":
-        raise TransferError(f"unsupported scheme {u.scheme!r}")
-    return http.client.HTTPConnection(u.hostname, u.port, timeout=10), u.path.rstrip("/")
+    if u.scheme == "http":
+        return (
+            http.client.HTTPConnection(u.hostname, u.port, timeout=10),
+            u.path.rstrip("/"),
+        )
+    if u.scheme == "https":
+        from kubeinfer_tpu.utils.httpbase import client_ssl_context
+
+        ctx = client_ssl_context(ca_file)
+        if ctx is None:
+            import ssl
+
+            ctx = ssl.create_default_context()
+        return (
+            http.client.HTTPSConnection(
+                u.hostname, u.port, timeout=10, context=ctx
+            ),
+            u.path.rstrip("/"),
+        )
+    raise TransferError(f"unsupported scheme {u.scheme!r}")
 
 
-def fetch_file_list(endpoint: str) -> list[FileEntry]:
+def fetch_file_list(endpoint: str, ca_file: str = "") -> list[FileEntry]:
     """GET /models → FileEntry list (follower.go:83-110 parity + metadata)."""
-    conn, base = _open(endpoint)
+    conn, base = _open(endpoint, ca_file)
     try:
         conn.request("GET", base + "/models")
         resp = conn.getresponse()
@@ -103,6 +122,7 @@ def download_file(
     rel_path: str,
     dest_dir: str,
     chunk_size: int = 1 << 20,
+    ca_file: str = "",
 ) -> int:
     """Download one file with resume; returns bytes transferred this call."""
     dest = pathlib.Path(dest_dir) / rel_path
@@ -110,7 +130,7 @@ def download_file(
     part = dest.with_name(dest.name + ".part")
 
     offset = part.stat().st_size if part.exists() else 0
-    conn, base = _open(endpoint)
+    conn, base = _open(endpoint, ca_file)
     transferred = 0
     expected_total = -1
     try:
@@ -169,6 +189,7 @@ def sync_model(
     attempts: int = 5,
     retry_delay_s: float = 0.5,
     sleep=time.sleep,
+    ca_file: str = "",
 ) -> list[str]:
     """Full follower sync: list + download all, with per-attempt retry.
 
@@ -186,7 +207,7 @@ def sync_model(
             ep = resolve()
             if not ep:
                 raise TransferError("no coordinator endpoint available")
-            entries = fetch_file_list(ep)
+            entries = fetch_file_list(ep, ca_file=ca_file)
             # Invalidate the completion marker BEFORE any mutation: a
             # re-sync that dies halfway (file deleted on checksum
             # mismatch, download failed) must not leave a stale marker
@@ -202,7 +223,7 @@ def sync_model(
                     if not entry.sha256 or _local_sha256(dest) == entry.sha256:
                         continue
                     dest.unlink()
-                download_file(ep, entry.path, dest_dir)
+                download_file(ep, entry.path, dest_dir, ca_file=ca_file)
                 if entry.sha256:
                     got = _local_sha256(dest)
                     if got != entry.sha256:

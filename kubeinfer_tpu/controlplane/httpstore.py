@@ -51,7 +51,12 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
     WatchEvent,
 )
-from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, token_matches
+from kubeinfer_tpu.utils.httpbase import (
+    BaseEndpointHandler,
+    client_ssl_context,
+    token_matches,
+    wrap_server_tls,
+)
 
 log = logging.getLogger(__name__)
 
@@ -75,9 +80,11 @@ class StoreServer:
     """
 
     def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 0,
-                 token: str = "", solve_handler=None) -> None:
+                 token: str = "", solve_handler=None,
+                 tls_cert: str = "", tls_key: str = "") -> None:
         self._store = store
         self._token = token
+        self._tls = bool(tls_cert)
         self._solve_handler = solve_handler
         # Event ring: long-pollers replay from here by resourceVersion.
         self._events: collections.deque[WatchEvent] = collections.deque(
@@ -205,7 +212,9 @@ class StoreServer:
             def do_DELETE(self):
                 self._route("DELETE")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = wrap_server_tls(
+            ThreadingHTTPServer((host, port), Handler), tls_cert, tls_key
+        )
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="store-http"
@@ -216,7 +225,8 @@ class StoreServer:
     @property
     def address(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     @property
     def port(self) -> int:
@@ -300,10 +310,14 @@ class RemoteStore:
     """
 
     def __init__(self, base_url: str, token: str = "",
-                 request_timeout_s: float = 35.0) -> None:
+                 request_timeout_s: float = 35.0,
+                 ca_file: str = "") -> None:
         self.base_url = base_url.rstrip("/")
         self._token = token
         self._timeout = request_timeout_s
+        # pinned CA bundle for https stores (None -> system default
+        # verification for https URLs; ignored for http)
+        self._ssl_ctx = client_ssl_context(ca_file)
 
     # -- plumbing ---------------------------------------------------------
 
@@ -317,7 +331,8 @@ class RemoteStore:
             req.add_header("Authorization", f"Bearer {self._token}")
         try:
             with urllib.request.urlopen(
-                req, timeout=timeout or self._timeout
+                req, timeout=timeout or self._timeout,
+                context=self._ssl_ctx,
             ) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:

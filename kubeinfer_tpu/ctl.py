@@ -186,6 +186,9 @@ def build_parser() -> argparse.ArgumentParser:
         "STORE_ADDR", "http://127.0.0.1:18080"))
     p.add_argument("--token-file", default=os.environ.get(
         "STORE_TOKEN_FILE", ""))
+    p.add_argument("--ca-file", default=os.environ.get(
+        "STORE_CA_FILE", ""),
+        help="CA bundle verifying an https store")
     p.add_argument("-n", "--namespace", default="default")
     sub = p.add_subparsers(dest="command", required=True)
 
@@ -210,7 +213,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     token = load_token(args.token_file) if args.token_file else ""
-    store = RemoteStore(args.store, token=token)
+    store = RemoteStore(args.store, token=token, ca_file=args.ca_file)
     return args.fn(store, args)
 
 

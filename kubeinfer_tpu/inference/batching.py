@@ -79,6 +79,12 @@ def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
         top_k=jnp.zeros((n_slots,), jnp.int32),
         top_p=jnp.ones((n_slots,), jnp.float32),
         rep_penalty=jnp.ones((n_slots,), jnp.float32),
+        # [n_slots, V] bool lives for the engine's lifetime and the
+        # keep-mask select threads through every decode step even when
+        # no request sets repetition_penalty (advisor r2: megabytes at
+        # production vocab x slot counts, not gigabytes — acceptable; if
+        # slot counts grow, allocate lazily / gate the select on
+        # any-penalty-enabled)
         seen=jnp.zeros((n_slots, cfg.vocab_size), bool),
         rng=jnp.zeros((n_slots, 2), jnp.uint32),
     )
@@ -284,11 +290,21 @@ class ContinuousEngine:
     shared decode batch."""
 
     def __init__(self, params: Params, cfg: ModelConfig,
-                 n_slots: int = 8, cache_len: int = 1024) -> None:
+                 n_slots: int = 8, cache_len: int = 1024,
+                 speculative=None) -> None:
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.cache_len = cache_len
+        # Optional SpeculativeEngine: a request arriving while the
+        # batcher is otherwise IDLE decodes through the draft instead of
+        # the slot machinery (speculation is a latency win exactly when
+        # there is nothing to batch with; under concurrent load the
+        # shared slots win on throughput, so busy periods never route
+        # here). Greedy requests keep token-identity; sampled requests
+        # keep the exact target distribution (speculative.py).
+        self.speculative = speculative
+        self.spec_served = 0  # telemetry: requests served via the draft
         self._state = _init_state(
             cfg, n_slots, cache_len, params["norm"].dtype
         )
@@ -423,6 +439,25 @@ class ContinuousEngine:
             )
             req.done.set()
 
+    def _serve_speculative(self, req: "_Request") -> None:
+        """Serve one request synchronously through the speculative
+        engine (scheduler-thread context; the batcher is idle, so
+        blocking it costs nothing — new arrivals queue and get slot-
+        batched on the next loop iteration)."""
+        try:
+            out = self.speculative.generate(
+                [req.prompt], max_new_tokens=req.max_new,
+                eos_id=req.eos_id, temperature=req.temperature,
+                seed=req.seed, top_k=req.top_k, top_p=req.top_p,
+            )
+            req.out_tokens.extend(
+                out.tokens[0, : out.lengths[0]].tolist()
+            )
+            self.spec_served += 1
+        except Exception as e:  # noqa: BLE001 — waiter must be released
+            req.failed = f"speculative decode failed: {e}"
+        req.done.set()
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             # admit as many pending requests as there are free slots
@@ -448,10 +483,22 @@ class ContinuousEngine:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         continue
+                    if req.cancelled.is_set():
+                        req.done.set()
+                        continue
+                    # the single-slot speculative route: nothing to
+                    # batch with, so the draft's latency win is free
+                    if (
+                        self.speculative is not None
+                        and req.rep_penalty == 1.0
+                        and self._queue.empty()
+                        and self.speculative.fits(
+                            len(req.prompt), req.max_new
+                        )
+                    ):
+                        self._serve_speculative(req)
+                        continue
                     with self._lock:
-                        if req.cancelled.is_set():
-                            req.done.set()
-                            continue
                         self._admit(0, req)
                 continue
 

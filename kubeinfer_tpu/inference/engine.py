@@ -78,7 +78,13 @@ def filter_logits(
     on": a disabled filter costs nothing per decode step at runtime.
     Order matches HF: temperature scaling happens in the caller BEFORE
     filtering, so top-p nuclei are computed on the tempered
-    distribution.
+    distribution. One documented divergence (advisor r2): the nucleus
+    cut is a probability THRESHOLD, so vocab entries exactly tying the
+    boundary token's probability are all kept — a slightly wider nucleus
+    than HF's shift-right positional cutoff on exact ties (e.g. sorted
+    probs [.4, .3, .3] at top_p=0.7 keep 3 here, 2 in HF). Exact
+    probability ties are measure-zero for real logits; the threshold
+    form avoids a scatter back through argsort indices on TPU.
     """
     V = logits.shape[-1]
     cap = min(TOP_K_CAP, V)
@@ -299,37 +305,27 @@ def make_caches(cfg: ModelConfig, B: int, cache_len: int, dtype):
     ]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
-)
-def _generate_jit(
+def decode_scan(
     params: Params,
-    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
-    prompt_len: jax.Array,  # i32[B]
     cfg: ModelConfig,
+    caches,  # per-layer (k, v) with the prompt's KV already written
+    next_logits: jax.Array,  # f32[B, V] logits at each row's last prompt pos
+    prompt: jax.Array,  # i32[B, T_bucket] (repetition-penalty seed state)
+    prompt_len: jax.Array,  # i32[B]; all rows must share one length
     max_new: int,
     cache_len: int,
-    prefill_chunk: int,
-    eos_id: jax.Array,  # i32 (negative = never stop)
-    temperature: jax.Array,  # f32; <=0 = greedy
-    top_k: jax.Array,  # i32; <1 = disabled
-    top_p: jax.Array,  # f32; >=1 = disabled
-    rep_penalty: jax.Array,  # f32; 1.0 = disabled
+    eos_id: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    rep_penalty: jax.Array,
     rng_key: jax.Array,
 ):
-    B, T = prompt.shape
-    caches = make_caches(cfg, B, cache_len, params["norm"].dtype)
-
-    # --- prefill: chunked so long prompts never materialize [T, T] ------
-    # Each chunk of C tokens attends causally against the cache (a
-    # [C, cache_len] mask), so peak attention memory is O(C * S) instead
-    # of O(T^2) — the difference between a 128k-token prompt fitting in
-    # HBM or not. The chunk loop is a scan (one trace regardless of
-    # chunk count; 131072/512 unrolled copies would blow up compile).
-    caches, next_logits = chunked_prefill(
-        params, prompt, prompt_len, cfg, caches, prefill_chunk
-    )
+    """The decode loop shared by every prefill strategy (chunked single-
+    device, sequence-parallel ring — sp_engine.py): sample from
+    ``next_logits``, then scan single-token steps against the caches.
+    Callers jit."""
+    B = prompt.shape[0]
 
     def sample(logits, key, seen):
         logits = apply_repetition_penalty(logits, seen, rep_penalty)
@@ -340,7 +336,6 @@ def _generate_jit(
     first = sample(next_logits, k0, seen)
     seen = record_seen(seen, first, rep_penalty)
 
-    # --- decode scan ----------------------------------------------------
     def step(carry, key):
         caches, tok, offset, done, seen = carry
         step_mask = (jnp.arange(cache_len)[None, None, :] <= offset[:, None, None])
@@ -381,6 +376,43 @@ def _generate_jit(
         is_eos.any(axis=1), is_eos.argmax(axis=1) + 1, max_new
     )
     return toks, first_eos.astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
+)
+def _generate_jit(
+    params: Params,
+    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    cfg: ModelConfig,
+    max_new: int,
+    cache_len: int,
+    prefill_chunk: int,
+    eos_id: jax.Array,  # i32 (negative = never stop)
+    temperature: jax.Array,  # f32; <=0 = greedy
+    top_k: jax.Array,  # i32; <1 = disabled
+    top_p: jax.Array,  # f32; >=1 = disabled
+    rep_penalty: jax.Array,  # f32; 1.0 = disabled
+    rng_key: jax.Array,
+):
+    B, T = prompt.shape
+    caches = make_caches(cfg, B, cache_len, params["norm"].dtype)
+
+    # --- prefill: chunked so long prompts never materialize [T, T] ------
+    # Each chunk of C tokens attends causally against the cache (a
+    # [C, cache_len] mask), so peak attention memory is O(C * S) instead
+    # of O(T^2) — the difference between a 128k-token prompt fitting in
+    # HBM or not. The chunk loop is a scan (one trace regardless of
+    # chunk count; 131072/512 unrolled copies would blow up compile).
+    caches, next_logits = chunked_prefill(
+        params, prompt, prompt_len, cfg, caches, prefill_chunk
+    )
+    return decode_scan(
+        params, cfg, caches, next_logits, prompt, prompt_len, max_new,
+        cache_len, eos_id, temperature, top_k, top_p, rep_penalty, rng_key,
+    )
 
 
 def prepare_prompts(

@@ -11,15 +11,22 @@ never touch HBM.
 
 Two mask sources share one softmax body (``_softmax_fold``):
 
+- ``flash_attention_ragged``: the causal+length mask
+  ((s <= q_offset + t) & (s < row_len)) derived IN-KERNEL from two
+  scalars via iotas — nothing [T, S]-sized exists anywhere, in HBM or
+  out. This covers BOTH production mask shapes: the engine's chunked
+  prefill (q_offset = chunk start, row_len = prompt length) and plain
+  causal self-attention (q_offset = 0, row_len = S —
+  ``causal_attention_auto``, the no-cache forward's path). r2 shipped
+  the general kernel an int8 [B, T, S] mask (O(B·T·S) HBM traffic);
+  r2's verdict item 8 relegates that to the arbitrary-mask fallback
+  below.
 - ``flash_attention``: a caller-supplied bool[B, T, S] mask ships to
   the kernel as int8 (head-independent — 4*n_kv*G times smaller than
-  the scores it replaces). General, matches model.attention's
-  signature, plugs into ``forward(attn_fn=...)`` via
-  ``attention_auto``.
-- ``flash_attention_ragged``: the engine's chunked-prefill mask
-  ((s <= chunk_offset + t) & (s < row_len)) derived IN-KERNEL from two
-  scalars via iotas — nothing [T, S]-sized exists anywhere, in HBM or
-  out. This is the engine's TPU prefill path.
+  the scores it replaces). The ARBITRARY-mask fallback: correct for any
+  mask, but pays the mask's HBM traffic — production paths use the
+  in-kernel variants; this remains for exotic masks (blockwise-sparse
+  experiments, bidirectional scoring).
 
 Layout: GQA folds the (T, G) axes into MXU rows — q becomes
 [B*n_kv, T*G, D], each S tile is one [T_q*G, D] x [D, S_k] matmul plus
@@ -32,6 +39,11 @@ the solver's accept kernel).
 Fully-masked rows reproduce the dense path's uniform-softmax output
 exactly (all scores -1e30 -> p == 1 everywhere -> o/l is the mean over
 S), so parity holds even on padding rows.
+
+FORWARD-ONLY: no custom_vjp is defined, so none of these kernels can
+sit under jax.grad — differentiated callers (train.causal_lm_loss) pin
+``attn_fn=attention``. A flash backward (recompute-based, like the
+public flash-attention backward) is future work.
 
 No reference counterpart: the reference delegates all attention to the
 external vLLM process (SURVEY.md §2, vllm.go:93-112).
@@ -317,4 +329,21 @@ def attention_auto(q, k, v, mask):
     allow, dense jnp otherwise. Drop-in for ``forward(attn_fn=...)``."""
     if flash_available(q.shape[1], k.shape[1], q.shape[3]):
         return flash_attention(q, k, v, mask)
+    return dense_attention(q, k, v, mask)
+
+
+def causal_attention_auto(q, k, v, mask):
+    """Plain causal self-attention (T == S) with the mask derived
+    in-kernel — model.forward's no-cache path binds this so training
+    and full-sequence prefill never ship a [B, T, T] tensor to the
+    kernel. ``mask`` is the caller's dense-fallback mask: the flash
+    branch never reads it and XLA dead-code-eliminates its
+    construction (the same contract as engine.chunked_prefill's flash
+    branch)."""
+    B, T = q.shape[0], q.shape[1]
+    S, D = k.shape[1], q.shape[3]
+    if T == S and flash_available(T, S, D):
+        return flash_attention_ragged(
+            q, k, v, 0, jnp.full((B,), S, jnp.int32)
+        )
     return dense_attention(q, k, v, mask)

@@ -250,9 +250,14 @@ def forward(
     attn_mask: jax.Array | None = None,  # bool[B, T, S]
     kv_caches: list[tuple[jax.Array, jax.Array]] | None = None,
     cache_offset: jax.Array | int = 0,
-    attn_fn=attention,
+    attn_fn=None,
 ) -> tuple[jax.Array, list | None]:
     """Logits [B, T, V] (+ updated KV caches when provided).
+
+    ``attn_fn=None`` (the default) means auto: the plain causal no-cache
+    path derives its mask in-kernel on TPU (causal_attention_auto);
+    every other path gets the dense ``attention``. Pass a callable to
+    pin a specific implementation.
 
     Without caches: plain causal self-attention over T (prefill/training).
     With caches: keys/values are written at ``cache_offset`` and attention
@@ -270,6 +275,22 @@ def forward(
         if kv_caches is not None:
             raise ValueError("decode with kv_caches requires attn_mask")
         attn_mask = jnp.broadcast_to(causal_mask(T)[None], (B, T, T))
+        if attn_fn is None:
+            # default causal forward (training / full-sequence prefill):
+            # derive the mask in-kernel on TPU instead of shipping the
+            # [B, T, T] tensor; the dense mask above survives only as
+            # the fallback operand (DCE'd when the kernel path runs).
+            # Callers that must stay on the dense einsum (e.g. GSPMD-
+            # sharded jits, where a Pallas custom call cannot partition)
+            # pass attn_fn=attention explicitly. Lazy import:
+            # flash_attention imports this module.
+            from kubeinfer_tpu.inference.flash_attention import (
+                causal_attention_auto,
+            )
+
+            attn_fn = causal_attention_auto
+    if attn_fn is None:
+        attn_fn = attention
 
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     x = params["embed_tokens"][tokens]

@@ -71,10 +71,12 @@ def _serving_metrics(registry: Registry):
 class InferenceServer:
     def __init__(self, engine, model_id: str, tokenizer=None,
                  host: str = "127.0.0.1", port: int = 8000,
-                 continuous=None, speculative=None) -> None:
+                 continuous=None, speculative=None, sp=None,
+                 tls_cert: str = "", tls_key: str = "") -> None:
         self.engine = engine
         self.continuous = continuous  # ContinuousEngine | None
         self.speculative = speculative  # SpeculativeEngine | None
+        self.sp = sp  # SPEngine | None (sequence-parallel long prompts)
         self.model_id = model_id
         self.tokenizer = tokenizer
         self.registry = Registry()
@@ -134,7 +136,11 @@ class InferenceServer:
                         {"error": {"message": str(e), "type": "server_error"}}
                     ))
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        from kubeinfer_tpu.utils.httpbase import wrap_server_tls
+
+        self._httpd = wrap_server_tls(
+            ThreadingHTTPServer((host, port), Handler), tls_cert, tls_key
+        )
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
 
@@ -214,25 +220,41 @@ class InferenceServer:
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
             eos_id = int(self.tokenizer.eos_token_id)
 
-        if (
+        if self.sp is not None and self.sp.fits(len(ids), max_tokens):
+            # long prompts shard their prefill over the mesh's sp axis
+            # (ring attention; sp_engine.py) and decode from the
+            # handed-off KV — the route that makes >single-chip-prefill
+            # contexts servable. Short prompts fall through: the
+            # collective traffic isn't worth it below --sp-min-prompt.
+            route_box["route"] = "sp"
+            out = self.sp.generate(
+                [ids], max_new_tokens=max_tokens, eos_id=eos_id,
+                temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p,
+                repetition_penalty=rep_penalty,
+            )
+            gen = out.tokens[0, : out.lengths[0]].tolist()
+        elif (
             self.speculative is not None
-            and temperature <= 0
-            # repetition penalty reshapes the target argmax per step
-            # using generated-token state the speculative verifier does
-            # not track; such requests take the normal paths
+            # repetition penalty reshapes the target distribution per
+            # step using generated-token state the speculative verifier
+            # does not track; such requests take the normal paths
             and rep_penalty == 1.0
             and self.speculative.fits(len(ids), max_tokens)
         ):
-            # a configured draft model routes GREEDY requests through
+            # a configured draft model routes requests through
             # speculative decoding (latency over batched throughput —
-            # the operator opted in with --draft-model); speculative
-            # decoding is greedy-only (rejection-sampling correction not
-            # implemented), so sampled requests take the normal paths,
-            # and requests within the target's context but beyond the
-            # k+1 speculation slack fall through rather than fail
+            # the operator opted in with --draft-model): greedy requests
+            # via argmax acceptance (token-identical to vanilla greedy),
+            # sampled requests via the rejection-sampling correction
+            # (exactly the target's sampling distribution). Requests
+            # within the target's context but beyond the k+1 speculation
+            # slack fall through rather than fail.
             route_box["route"] = "speculative"
             out = self.speculative.generate(
-                [ids], max_new_tokens=max_tokens, eos_id=eos_id
+                [ids], max_new_tokens=max_tokens, eos_id=eos_id,
+                temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p,
             )
             gen = out.tokens[0, : out.lengths[0]].tolist()
         elif (
@@ -294,7 +316,11 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() handshakes with serve_forever and BLOCKS FOREVER if
+        # the serve loop never ran — callers that used complete()
+        # directly (tests, the multichip dryrun) still get a clean close
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
 
 
@@ -317,6 +343,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="shard long-prompt prefill over this many mesh "
+                        "devices via ring attention (sp_engine.py); "
+                        "requests below --sp-min-prompt keep the normal "
+                        "routes")
+    p.add_argument("--sp-min-prompt", type=int, default=1024,
+                   help="minimum prompt length (tokens) routed through "
+                        "the sequence-parallel engine")
     p.add_argument("--gpu-memory-utilization", type=float, default=0.9)
     p.add_argument("--dtype", default="auto",
                    choices=["auto", "bfloat16", "float32"])
@@ -336,6 +370,10 @@ def main(argv: list[str] | None = None) -> int:
                         "target's vocabulary")
     p.add_argument("--speculation-depth", type=int, default=4,
                    help="draft tokens proposed per verification round")
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve completions over TLS (PEM cert; key via "
+                        "--tls-key-file)")
+    p.add_argument("--tls-key-file", default="")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -369,14 +407,29 @@ def main(argv: list[str] | None = None) -> int:
     else:
         max_cache = cfg.max_position_embeddings
 
-    if args.tensor_parallel_size > 1:
-        # place params on a tp mesh; GSPMD partitions the jitted forward
+    mesh = None
+    if args.tensor_parallel_size > 1 or args.sequence_parallel_size > 1:
+        # place params on a tp x sp mesh; GSPMD partitions the jitted
+        # forward over tp, and the SP engine shard_maps prefill over sp
         from kubeinfer_tpu.inference.sharding import (
             make_inference_mesh, shard_params,
         )
 
-        mesh = make_inference_mesh(tp=args.tensor_parallel_size, sp=1, dp=1)
-        params = shard_params(params, mesh, cfg)
+        mesh = make_inference_mesh(
+            tp=args.tensor_parallel_size,
+            sp=args.sequence_parallel_size, dp=1,
+        )
+        if args.tensor_parallel_size > 1:
+            params = shard_params(params, mesh, cfg)
+
+    sp_engine = None
+    if args.sequence_parallel_size > 1:
+        from kubeinfer_tpu.inference.sp_engine import SPEngine
+
+        sp_engine = SPEngine(
+            params, cfg, mesh, max_cache_len=max_cache,
+            min_prompt=args.sp_min_prompt,
+        )
 
     engine = Engine(params, cfg, max_cache_len=max_cache)
     speculative = None
@@ -413,11 +466,13 @@ def main(argv: list[str] | None = None) -> int:
         continuous = ContinuousEngine(
             params, cfg, n_slots=args.batch_slots,
             cache_len=min(max_cache, 4096),
+            speculative=speculative,
         ).start()
     srv = InferenceServer(
         engine, model_id=args.model, tokenizer=tokenizer,
         host=args.host, port=args.port, continuous=continuous,
-        speculative=speculative,
+        speculative=speculative, sp=sp_engine,
+        tls_cert=args.tls_cert_file, tls_key=args.tls_key_file,
     ).start()
     log.info("native inference server on %s:%d (model %s)",
              args.host, srv.port, args.model)

@@ -120,7 +120,14 @@ def forward_tensor_parallel(
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
     def fwd(p, t, cfg: ModelConfig):
-        out, _ = forward(p, t, cfg)
+        from kubeinfer_tpu.inference.model import attention
+
+        # attn_fn pinned to the dense einsum path: GSPMD partitions
+        # einsums across the mesh, but the default forward's causal
+        # flash kernel is a Pallas custom call that GSPMD cannot
+        # partition — under a sharded jit it would replicate (or fail
+        # to lower) instead of sharding over heads.
+        out, _ = forward(p, t, cfg, attn_fn=attention)
         return jax.lax.with_sharding_constraint(
             out, NamedSharding(mesh, P("dp", None, None))
         )

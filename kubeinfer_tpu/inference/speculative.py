@@ -1,13 +1,31 @@
 """Speculative decoding: a small draft model proposes, the target verifies.
 
-Greedy-only by design: with greedy acceptance (accept a draft token iff
-it equals the target's own argmax at that position) the output is
-**token-identical to vanilla greedy decoding** for ANY draft model — the
-draft only changes how many target forwards the sequence costs, never
-what it says. That identity is the correctness contract
-(tests/test_speculative.py pins it against Engine.generate); sampling-
-based speculative decoding needs the rejection-sampling correction and
-is out of scope.
+Two acceptance modes, selected by temperature:
+
+- **Greedy** (temperature <= 0): accept a draft token iff it equals the
+  target's own argmax at that position. The output is **token-identical
+  to vanilla greedy decoding** for ANY draft model — the draft only
+  changes how many target forwards the sequence costs, never what it
+  says. That identity is the correctness contract
+  (tests/test_speculative.py pins it against Engine.generate). Caveat
+  (advisor r2): the identity additionally assumes the backend produces
+  shape-independent matmul/softmax numerics — the verification forward
+  runs at T=k+1 while vanilla decode runs T=1, and XLA may fuse or
+  reassociate differently per shape, so a near-tied argmax could
+  diverge on some backends even though the CPU tests pin it (same class
+  of caveat as the flash-vs-dense note in engine.chunked_prefill).
+- **Sampled** (temperature > 0): the rejection-sampling correction from
+  the speculative-decoding literature (PAPERS.md). The draft SAMPLES
+  x_i ~ q_i from its own warped distribution (same temperature/top-k/
+  top-p warping as vanilla sampling); the target accepts x_i with
+  probability min(1, p_i(x_i)/q_i(x_i)); the first rejected position
+  resamples from the residual distribution norm(max(p_i - q_i, 0)), and
+  a fully-accepted round samples its bonus token from p_{k+1}. This
+  yields EXACTLY the target's sampling distribution — not an
+  approximation — for any draft (tests pin the distributional match
+  against vanilla Engine sampling). Repetition penalty stays excluded
+  (it reshapes p per step from generated-token state the verifier's
+  parallel window cannot see; the server routes such requests away).
 
 Static shapes throughout (the jit discipline of engine.py):
 
@@ -49,6 +67,8 @@ from kubeinfer_tpu.inference.engine import (
     GenerationResult,
     PREFILL_CHUNK,
     chunked_prefill,
+    filter_logits,
+    gumbel_pick,
     make_caches,
     prepare_prompts,
 )
@@ -59,10 +79,21 @@ def _greedy(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def _warped_dist(logits, temperature, top_k, top_p):
+    """The sampling distribution vanilla decoding draws from: softmax of
+    the tempered, top-k/top-p-filtered logits (engine.gumbel_sample's
+    gumbel-argmax samples exactly this). Both p (target) and q (draft)
+    must use the SAME warping or the acceptance ratio is against the
+    wrong measure."""
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    filtered = filter_logits(scaled, top_k, top_p)
+    return jax.nn.softmax(filtered, axis=-1), filtered
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
-                     "prefill_chunk"),
+                     "prefill_chunk", "sampled"),
 )
 def _spec_generate_jit(
     params: Params,
@@ -76,9 +107,19 @@ def _spec_generate_jit(
     k: int,
     prefill_chunk: int,
     eos_id: jax.Array,  # i32 (negative = never stop)
+    sampled: bool = False,
+    temperature: jax.Array | float = 0.0,
+    top_k: jax.Array | int = 0,
+    top_p: jax.Array | float = 1.0,
+    rng_key: jax.Array | None = None,
 ):
     B, T = prompt.shape
     dtype = params["norm"].dtype
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
     tcaches = make_caches(cfg, B, cache_len, dtype)
     dcaches = make_caches(dcfg, B, cache_len, dparams["norm"].dtype)
 
@@ -88,7 +129,13 @@ def _spec_generate_jit(
     dcaches, _ = chunked_prefill(
         dparams, prompt, prompt_len, dcfg, dcaches, prefill_chunk
     )
-    first = _greedy(t_logits)  # [B] the target's first generated token
+    key_first, rng_key = jax.random.split(rng_key)
+    if sampled:
+        # same sampling math as engine.decode_scan's first token
+        _, filt = _warped_dist(t_logits, temperature, top_k, top_p)
+        first = gumbel_pick(t_logits, filt, key_first, temperature)
+    else:
+        first = _greedy(t_logits)  # [B] the target's first token
 
     cache_pos = jnp.arange(cache_len)
 
@@ -99,8 +146,10 @@ def _spec_generate_jit(
         q_pos = offsets[:, None] + jnp.arange(q_width)[None, :]  # [B, W]
         return cache_pos[None, None, :] <= q_pos[:, :, None]
 
-    def draft_propose(dcaches, prev, cur, offsets):
-        """k greedy draft steps; returns (dcaches, drafts i32[B, k]).
+    def draft_propose(dcaches, prev, cur, offsets, key):
+        """k draft steps (greedy argmax, or sampled from the draft's
+        warped distribution q); returns (dcaches, drafts i32[B, k],
+        qdists f32[B, k, V] — zeros in greedy mode).
 
         The FIRST step runs a 2-token window [prev, cur] (positions
         offsets-1, offsets): after a full-acceptance round the draft
@@ -118,9 +167,14 @@ def _spec_generate_jit(
             kv_caches=dcaches,
             cache_offset=offsets - 1,
         )
-        d1 = _greedy(logits[:, 1])
+        if sampled:
+            keys = jax.random.split(key, k)
+            q1, filt1 = _warped_dist(logits[:, 1], temperature, top_k, top_p)
+            d1 = gumbel_pick(logits[:, 1], filt1, keys[0], temperature)
+        else:
+            d1 = _greedy(logits[:, 1])
 
-        def step(carry, i):
+        def step(carry, x):
             dcaches, tok, off = carry
             logits, dcaches = forward(
                 dparams, tok[:, None], dcfg,
@@ -129,20 +183,36 @@ def _spec_generate_jit(
                 kv_caches=dcaches,
                 cache_offset=off,
             )
+            if sampled:
+                qi, filti = _warped_dist(
+                    logits[:, 0], temperature, top_k, top_p
+                )
+                nxt = gumbel_pick(logits[:, 0], filti, x, temperature)
+                return (dcaches, nxt, off + 1), (nxt, qi)
             nxt = _greedy(logits[:, 0])
-            return (dcaches, nxt, off + 1), nxt
+            return (dcaches, nxt, off + 1), (nxt, ())
 
-        (dcaches, _, _), rest = jax.lax.scan(
-            step, (dcaches, d1, offsets + 1), jnp.arange(k - 1)
+        xs = keys[1:] if sampled else jnp.arange(k - 1)
+        (dcaches, _, _), (rest, rest_q) = jax.lax.scan(
+            step, (dcaches, d1, offsets + 1), xs
         )
         drafts = jnp.concatenate([d1[:, None], rest.swapaxes(0, 1)], axis=1)
-        return dcaches, drafts  # [B, k]
+        if sampled:
+            qdists = jnp.concatenate(
+                [q1[:, None], rest_q.swapaxes(0, 1)], axis=1
+            )  # [B, k, V]
+        else:
+            qdists = jnp.zeros((B, k, cfg.vocab_size), jnp.float32)
+        return dcaches, drafts, qdists
 
     def round_step(carry, _):
         (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-         accepted, rounds) = carry
+         accepted, rounds, key) = carry
+        key, k_draft, k_acc, k_res = jax.random.split(key, 4)
 
-        dcaches, drafts = draft_propose(dcaches, prev, cur, offsets)
+        dcaches, drafts, qdists = draft_propose(
+            dcaches, prev, cur, offsets, k_draft
+        )
         window = jnp.concatenate([cur[:, None], drafts], axis=1)
         t_logits, tcaches = forward(
             params, window, cfg,
@@ -151,22 +221,64 @@ def _spec_generate_jit(
             kv_caches=tcaches,
             cache_offset=offsets,
         )
-        targets = _greedy(t_logits)
 
-        # longest prefix of drafts the target agrees with
-        agree = drafts == targets[:, :k]
-        prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted draft count, 0..k
-
-        # emitted tokens this round: drafts[:, :m] then targets[:, m] —
-        # a static [B, k+1] row whose slots past m duplicate targets[:, m]
-        # (harmless: n_emit bounds what counts)
         emit_idx = jnp.arange(k + 1)[None, :]
-        emitted = jnp.where(
-            emit_idx < m[:, None],
-            jnp.pad(drafts, ((0, 0), (0, 1))),
-            jnp.take_along_axis(targets, m[:, None], axis=1),
-        )
+        if sampled:
+            # Rejection sampling: accept x_i ~ q_i with prob
+            # min(1, p_i(x_i)/q_i(x_i)) — u*q < p avoids the division
+            # (q(x) > 0 whenever x was sampled from q). The first
+            # rejected position resamples from norm(max(p - q, 0));
+            # padding q with a zero row makes the fully-accepted bonus
+            # position the same formula (residual = p_{k+1}).
+            pdists, _ = _warped_dist(t_logits, temperature, top_k, top_p)
+            px = jnp.take_along_axis(
+                pdists[:, :k], drafts[..., None], axis=-1
+            )[..., 0]
+            qx = jnp.take_along_axis(
+                qdists, drafts[..., None], axis=-1
+            )[..., 0]
+            u = jax.random.uniform(k_acc, (B, k))
+            accept_tok = u * qx < px
+            prefix_ok = jnp.cumprod(accept_tok.astype(jnp.int32), axis=1)
+            m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
+            q_pad = jnp.concatenate(
+                [qdists, jnp.zeros_like(qdists[:, :1])], axis=1
+            )
+            p_m = jnp.take_along_axis(
+                pdists, m[:, None, None], axis=1
+            )[:, 0]
+            q_m = jnp.take_along_axis(
+                q_pad, m[:, None, None], axis=1
+            )[:, 0]
+            resid = jnp.maximum(p_m - q_m, 0.0)
+            s = jnp.sum(resid, axis=-1, keepdims=True)
+            # all-zero residual (p identical to q under the filters):
+            # every token was acceptable, resample from p directly
+            dist = jnp.where(s > 0, resid / jnp.maximum(s, 1e-38), p_m)
+            logdist = jnp.where(dist > 0, jnp.log(dist), -jnp.inf)
+            repl = jax.random.categorical(k_res, logdist, axis=-1).astype(
+                jnp.int32
+            )
+            emitted = jnp.where(
+                emit_idx < m[:, None],
+                jnp.pad(drafts, ((0, 0), (0, 1))),
+                repl[:, None],
+            )
+        else:
+            targets = _greedy(t_logits)
+            # longest prefix of drafts the target agrees with
+            agree = drafts == targets[:, :k]
+            prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+            m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
+
+            # emitted tokens this round: drafts[:, :m] then targets[:, m]
+            # — a static [B, k+1] row whose slots past m duplicate
+            # targets[:, m] (harmless: n_emit bounds what counts)
+            emitted = jnp.where(
+                emit_idx < m[:, None],
+                jnp.pad(drafts, ((0, 0), (0, 1))),
+                jnp.take_along_axis(targets, m[:, None], axis=1),
+            )
         is_eos = (emitted == eos_id) & (eos_id >= 0)
         first_eos = jnp.where(
             is_eos.any(axis=1),
@@ -210,7 +322,7 @@ def _spec_generate_jit(
         offsets = offsets + n_emit
         return (
             (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-             accepted, rounds),
+             accepted, rounds, key),
             (),
         )
 
@@ -229,7 +341,7 @@ def _spec_generate_jit(
     )[:, 0]
     state0 = (
         tcaches, dcaches, prev0, first, offsets0, written0, counts0, done0,
-        jnp.zeros((B,), jnp.int32), jnp.int32(0),
+        jnp.zeros((B,), jnp.int32), jnp.int32(0), rng_key,
     )
 
     if max_new > 1:
@@ -284,6 +396,10 @@ class SpeculativeEngine:
         prompts: list[list[int]],
         max_new_tokens: int = 32,
         eos_id: int = -1,
+        temperature: float = 0.0,
+        seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> GenerationResult:
         if not prompts:
             return GenerationResult(
@@ -302,6 +418,11 @@ class SpeculativeEngine:
             self.cfg, self.draft_cfg,
             max_new_tokens, cache_len, self.k, PREFILL_CHUNK,
             jnp.int32(eos_id),
+            sampled=temperature > 0,
+            temperature=jnp.float32(temperature),
+            top_k=jnp.int32(top_k),
+            top_p=jnp.float32(top_p),
+            rng_key=jax.random.PRNGKey(seed),
         )
         # diagnostics for tests/telemetry: accepted draft tokens per row
         # and speculation rounds executed (the cost side of the trade)

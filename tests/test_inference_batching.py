@@ -110,3 +110,59 @@ class TestContinuousBatching:
         cont, _ = engines
         with pytest.raises(ValueError, match="slot capacity"):
             cont.submit(list(range(1, 60)), max_new_tokens=30)
+
+
+class TestSpeculativeRouting:
+    """The batcher's idle path routes through the draft; busy periods
+    keep slot batching (VERDICT r2 item 3: speculative inside the
+    continuous batcher for the single-slot case)."""
+
+    def _engines(self):
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        from kubeinfer_tpu.inference.speculative import SpeculativeEngine
+
+        spec = SpeculativeEngine(params, cfg, params, cfg, k=2)
+        eng = ContinuousEngine(
+            params, cfg, n_slots=2, cache_len=256, speculative=spec
+        )
+        return eng, params, cfg
+
+    def test_idle_request_served_speculatively(self):
+        eng, params, cfg = self._engines()
+        eng.start()
+        try:
+            toks = eng.generate([5, 6, 7], max_new_tokens=6)
+            assert eng.spec_served == 1
+            # token identity with the per-request engine (greedy)
+            from kubeinfer_tpu.inference.engine import Engine
+
+            ref = Engine(params, cfg).generate([[5, 6, 7]], max_new_tokens=6)
+            assert toks == ref.tokens[0, : ref.lengths[0]].tolist()
+        finally:
+            eng.stop()
+
+    def test_prequeued_burst_uses_slots(self):
+        eng, _, _ = self._engines()
+        # fill the queue BEFORE the scheduler runs: the admission sweep
+        # sees multiple pending requests and batches them in slots
+        reqs = [eng.submit([2, 3], max_new_tokens=4) for _ in range(3)]
+        eng.start()
+        try:
+            for r in reqs:
+                assert r.done.wait(120)
+                assert not r.failed
+            assert eng.spec_served == 0
+        finally:
+            eng.stop()
+
+    def test_repetition_penalty_skips_speculative(self):
+        eng, _, _ = self._engines()
+        eng.start()
+        try:
+            toks = eng.generate([4, 5], max_new_tokens=4,
+                                repetition_penalty=1.3)
+            assert len(toks) == 4
+            assert eng.spec_served == 0
+        finally:
+            eng.stop()

@@ -183,12 +183,29 @@ class TestSpeculativeServing:
         # the speculative path actually ran (stats recorded)
         assert srv.speculative.last_stats["rounds"] >= 1
 
-    def test_sampled_request_skips_speculation(self, spec_server):
+    def test_sampled_request_takes_speculation(self, spec_server):
+        """Sampled requests ride the draft too since r3's rejection-
+        sampling correction (speculative.py) — only repetition-penalty
+        requests still skip it."""
         srv, _ = spec_server
         srv.speculative.last_stats = None
         body = {
             "prompt": [5, 6, 7], "max_tokens": 4,
             "temperature": 0.8, "seed": 7,
+        }
+        code, resp = post(
+            f"http://127.0.0.1:{srv.port}/v1/completions", body
+        )
+        assert code == 200
+        assert len(resp["choices"][0]["tokens"]) >= 1
+        assert srv.speculative.last_stats is not None  # path taken
+
+    def test_repetition_penalty_skips_speculation(self, spec_server):
+        srv, _ = spec_server
+        srv.speculative.last_stats = None
+        body = {
+            "prompt": [5, 6, 7], "max_tokens": 4,
+            "repetition_penalty": 1.3,
         }
         code, resp = post(
             f"http://127.0.0.1:{srv.port}/v1/completions", body

@@ -365,3 +365,82 @@ class TestReviewRegressions:
         store.update(Workload.KIND, w.to_dict())
         time.sleep(1.0)
         assert phases(store) == ["Starting"]
+
+
+class TestObservedMemory:
+    """Heartbeats with a live HBM observer: external usage shrinks the
+    advertised free memory; framework-owned replica demand does not
+    (anti-oscillation rule in NodeAgent.heartbeat). r2 verdict weak #5."""
+
+    def _agent(self, store, tmp_path, observe):
+        return NodeAgent(
+            store, "node-obs",
+            gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path / "models"),
+            downloader=fab_downloader(),
+            observe_memory=observe,
+        )
+
+    def test_external_usage_shrinks_free(self, tmp_path):
+        store = Store()
+        # 64GiB HBM observed, 20GiB used by something external
+        agent = self._agent(
+            store, tmp_path, lambda: (64 << 30, 44 << 30)
+        )
+        agent.heartbeat()
+        st = NodeState.from_dict(store.get(NodeState.KIND, "node-obs"))
+        assert st.gpu_memory_bytes == 64 << 30
+        assert st.gpu_memory_free_bytes == 44 << 30
+
+    def test_framework_owned_usage_stays_free(self, tmp_path):
+        store = Store()
+        # observed usage 20GiB, but 16GiB of it is OUR replica: only the
+        # 4GiB external share shrinks the advertisement (the solver
+        # re-solves incumbents from full capacity each tick)
+        agent = self._agent(
+            store, tmp_path, lambda: (64 << 30, 44 << 30)
+        )
+        w = mk_workload(store, name="own", replicas=1, nodes=("node-obs",))
+        agent.sync_replicas([w])
+        try:
+            agent.heartbeat()
+            st = NodeState.from_dict(store.get(NodeState.KIND, "node-obs"))
+            assert st.gpu_memory_free_bytes == 60 << 30
+        finally:
+            agent.stop()
+
+    def test_no_observer_reports_full_capacity(self, tmp_path):
+        store = Store()
+        agent = self._agent(store, tmp_path, None)
+        agent.heartbeat()
+        st = NodeState.from_dict(store.get(NodeState.KIND, "node-obs"))
+        assert st.gpu_memory_free_bytes == 64 << 30
+
+    def test_solver_places_fewer_replicas_on_eaten_node(self, tmp_path):
+        """End to end through the reconciler: a node with externally
+        consumed HBM attracts proportionally fewer replicas."""
+        from kubeinfer_tpu.api.types import LLMService
+        from kubeinfer_tpu.controller.reconciler import Controller
+
+        store = Store()
+        # node-full: all 64GiB free; node-eaten: 40 of 64GiB externally
+        # consumed -> fits only 1 replica of 16GiB
+        full = self._agent(store, tmp_path, lambda: (64 << 30, 64 << 30))
+        full.node_name = "node-full"
+        eaten = self._agent(store, tmp_path, lambda: (64 << 30, 24 << 30))
+        eaten.node_name = "node-eaten"
+        full.heartbeat()
+        eaten.heartbeat()
+
+        svc = LLMService.from_dict({
+            "metadata": {"name": "spread", "namespace": "default"},
+            "spec": {"model": "org/m", "replicas": 4, "gpuPerReplica": 1,
+                     "gpuMemory": "16Gi"},
+        })
+        store.create(LLMService.KIND, svc.to_dict())
+        Controller(store).reconcile_once()
+        w = Workload.from_dict(store.get(Workload.KIND, "spread"))
+        placed = [r.node for r in w.replicas if r.node]
+        assert len(placed) == 4
+        assert placed.count("node-eaten") == 1, placed
+        assert placed.count("node-full") == 3, placed

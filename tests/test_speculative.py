@@ -152,3 +152,91 @@ class TestAcceptanceDiagnostics:
         )
         assert eng.fits(32, 27)       # 32+27+5 = 64
         assert not eng.fits(32, 28)   # 65 > 64
+
+
+class TestSampledSpeculative:
+    """Rejection-sampling correction: sampled speculative output must be
+    distributed EXACTLY as vanilla sampling from the target — for any
+    draft. Verified empirically on a 16-token vocabulary (large enough
+    batches that total-variation noise is well under the threshold) for
+    both a self-draft (acceptance ~1: bonus-token path) and an
+    independent random draft (low acceptance: residual-resample path)."""
+
+    VOCAB16 = ModelConfig(
+        vocab_size=16,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+
+    def _pooled_dist(self, gen_fn, n_batches=3, B=256, max_new=3):
+        counts = np.zeros(16, np.int64)
+        prompt = [3, 7, 1, 9]
+        for seed in range(n_batches):
+            out = gen_fn([prompt] * B, max_new, seed)
+            for b in range(B):
+                for t in out.tokens[b, : out.lengths[b]]:
+                    counts[int(t)] += 1
+        return counts / counts.sum()
+
+    def _tv(self, a, b):
+        return 0.5 * float(np.abs(a - b).sum())
+
+    @pytest.mark.parametrize("self_draft", [True, False])
+    def test_sampled_matches_vanilla_distribution(self, self_draft):
+        cfg = self.VOCAB16
+        tparams = init_params(cfg, jax.random.PRNGKey(0))
+        dparams = (
+            tparams if self_draft else init_params(cfg, jax.random.PRNGKey(9))
+        )
+        spec = SpeculativeEngine(tparams, cfg, dparams, cfg, k=3)
+        eng = Engine(tparams, cfg)
+
+        temperature, top_p = 0.8, 0.9
+
+        spec_dist = self._pooled_dist(
+            lambda p, m, s: spec.generate(
+                p, max_new_tokens=m, temperature=temperature, top_p=top_p,
+                seed=s,
+            )
+        )
+        van_dist = self._pooled_dist(
+            lambda p, m, s: eng.generate(
+                p, max_new_tokens=m, temperature=temperature, top_p=top_p,
+                seed=s,
+            )
+        )
+        tv = self._tv(spec_dist, van_dist)
+        assert tv < 0.12, f"TV(spec, vanilla) = {tv:.3f} (self={self_draft})"
+        # sensitivity: a genuinely different distribution (greedy
+        # collapse) is far away — the threshold above is discriminative
+        greedy_dist = self._pooled_dist(
+            lambda p, m, s: eng.generate(p, max_new_tokens=m, seed=s),
+            n_batches=1, B=64,
+        )
+        assert self._tv(van_dist, greedy_dist) > 0.3
+
+    def test_sampled_seed_deterministic(self, target_params, draft_params):
+        spec = SpeculativeEngine(target_params, TINY, draft_params, DRAFT_CFG)
+        prompts = [[5, 6, 7]]
+        a = spec.generate(prompts, max_new_tokens=6, temperature=0.7,
+                          top_p=0.9, seed=11)
+        b = spec.generate(prompts, max_new_tokens=6, temperature=0.7,
+                          top_p=0.9, seed=11)
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        c = spec.generate(prompts, max_new_tokens=6, temperature=0.7,
+                          top_p=0.9, seed=12)
+        assert not np.array_equal(a.tokens, c.tokens) or a.lengths[0] <= 1
+
+    def test_sampled_acceptance_nonzero_with_self_draft(self, target_params):
+        # p == q: every draft token accepted (u*q < p a.s.), so the
+        # speedup survives sampling
+        spec = SpeculativeEngine(target_params, TINY, target_params, TINY,
+                                 k=3)
+        out = spec.generate([[2, 3, 4]], max_new_tokens=12, temperature=0.9,
+                            seed=0)
+        assert out.lengths[0] == 12
+        assert spec.last_stats["accepted_drafts"].sum() >= 6
