@@ -137,6 +137,21 @@ class SPEngine:
     ) -> None:
         if "sp" not in mesh.shape or mesh.shape["sp"] < 2:
             raise ValueError("SPEngine needs a mesh with an sp axis >= 2")
+        if mesh.shape.get("tp", 1) > 1:
+            # the ring body's in_specs replicate params over every mesh
+            # axis: combined with TP-sharded weights, jit must all-gather
+            # the FULL model onto each device for sp-routed requests —
+            # correct but tp x the intended per-device weight footprint.
+            # Sharding the ring body's weights over tp is future work.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sequence-parallel serving on a tp=%d mesh replicates "
+                "the full model per device on the sp route (weights are "
+                "all-gathered out of their tp sharding); expect tp-fold "
+                "weight HBM on long-prompt requests",
+                mesh.shape["tp"],
+            )
         self.params = params
         self.cfg = cfg
         self.mesh = mesh

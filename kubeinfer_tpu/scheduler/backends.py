@@ -103,6 +103,27 @@ class SolveResult:
     extras: dict[str, float] = field(default_factory=dict)
 
 
+def _descending_stable_perm(pr: np.ndarray) -> np.ndarray:
+    """Stable descending-priority permutation.
+
+    Priorities are almost always a handful of small integer levels;
+    mapping them to uint8 keys lets numpy's stable integer argsort take
+    its radix path (~15x cheaper than the f32 mergesort at the 10k-job
+    scale, and this sort sits inside the headline pack+solve latency).
+    Arbitrary floats (or a >256-level integer range) fall back to the
+    f32 argsort. Output is identical to ``np.argsort(-pr,
+    kind="stable")`` in all cases.
+    """
+    pi = pr.astype(np.int64)
+    if (pi == pr).all():
+        lo, hi = int(pi.min()), int(pi.max())
+        if 1 < hi - lo + 1 <= 256:
+            # numpy's stable argsort on uint8 keys is a radix sort
+            # (~0.02ms at 10k vs ~0.35ms for f32 mergesort)
+            return np.argsort((hi - pi).astype(np.uint8), kind="stable")
+    return np.argsort(-pr, kind="stable")
+
+
 class SchedulerBackend:
     """Places a batch of replicas onto nodes."""
 
@@ -259,7 +280,7 @@ class JaxBackend(SchedulerBackend):
         if req.job_priority is not None and req.num_jobs > 1:
             pr = np.asarray(req.job_priority)
             if np.any(pr[1:] > pr[:-1]):  # not already descending
-                perm = np.argsort(-pr, kind="stable")
+                perm = _descending_stable_perm(pr)
 
         # Single-buffer packing: the whole problem ships in ONE transfer
         # and unpacks with free slices/bitcasts inside the jitted solve —

@@ -303,8 +303,9 @@ def _accept_reduce_jnp(
     (log^2-depth bitonic stages, ~0.8ms/round). Winner demands come from
     unpacking the job index embedded in the reduced key — one [N]-from-[J]
     gather, acceptable on the CPU/sharded paths this serves; the Pallas
-    twin ``pallas_kernels.accept_reduce_pallas`` tracks them inside the
-    reduction instead (the gather cost ~15us/accept on TPU).
+    twin (``pallas_kernels.accept_phase_pallas``'s verdict kernel) tracks
+    them inside the reduction instead (the gather cost ~15us/accept on
+    TPU).
     """
     J = choice.shape[0]
     idx_bits = max((J - 1).bit_length(), 1)
@@ -330,9 +331,6 @@ def _dense_accept(
     gpu_free: jax.Array,  # f32[N]
     mem_free: jax.Array,
     num_nodes: int,
-    accept_reduce=None,
-    accept_flags=None,
-    tile_act=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scatter- and sort-free per-node conflict resolution.
 
@@ -354,14 +352,9 @@ def _dense_accept(
     pass calls this with post-first-pass capacities, where a round-start-
     feasible bid can exceed what's left.
     """
-    if accept_reduce is None:
-        tot_gpu, tot_mem, win_key, win_gpu, win_mem = _accept_reduce_jnp(
-            choice, accept_key, gpu_demand, mem_demand, num_nodes
-        )
-    else:
-        tot_gpu, tot_mem, win_key, win_gpu, win_mem = accept_reduce(
-            choice, accept_key, gpu_demand, mem_demand, num_nodes, tile_act
-        )
+    tot_gpu, tot_mem, win_key, win_gpu, win_mem = _accept_reduce_jnp(
+        choice, accept_key, gpu_demand, mem_demand, num_nodes
+    )
     fits_all = (tot_gpu <= gpu_free + _EPS) & (tot_mem <= mem_free + _EPS)
 
     has_win = win_key != jnp.int32(0x7FFFFFFF)
@@ -378,28 +371,23 @@ def _dense_accept(
     # is three [J]-from-[N] gathers per accept pass; TPU lowers those to
     # serialized dynamic-slice loops (measured ~0.53ms/round at 12288x1024,
     # 70% of the whole round). One fused [N, J] broadcast-compare + any()
-    # on the VPU instead (the ``accept_flags`` Pallas twin additionally
-    # skips bidder-free J tiles). Winner identity rides the reduced key
+    # on the VPU instead (the Pallas twin, accept_phase_pallas, skips
+    # bidder-free J tiles too). Winner identity rides the reduced key
     # itself: win_key[n] == accept_key[j] iff j won node n (the key
     # embeds the job index, so it is single-valued per job).
-    if accept_flags is not None:
-        accept = accept_flags(
-            choice, accept_key, fits_all, fits_win, win_key, tile_act
-        )
-    else:
-        n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
-        mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel: none
-        accept = jnp.any(
-            mine
-            & (
-                fits_all[:, None]
-                | (
-                    fits_win[:, None]
-                    & (win_key[:, None] == accept_key[None, :])
-                )
-            ),
-            axis=0,
-        )
+    n_iota = jnp.arange(num_nodes, dtype=jnp.int32)
+    mine = choice[None, :] == n_iota[:, None]  # [N, J]; sentinel: none
+    accept = jnp.any(
+        mine
+        & (
+            fits_all[:, None]
+            | (
+                fits_win[:, None]
+                & (win_key[:, None] == accept_key[None, :])
+            )
+        ),
+        axis=0,
+    )
     return accept, used_gpu, used_mem
 
 
@@ -611,19 +599,15 @@ def solve_greedy(
                 node_idx_bits=node_idx_bits, interpret=interp,
             )
 
-        # The accepts reuse the round's bid-activity tiles (threaded via
-        # _dense_accept's tile_act): bidders are a subset of bid-active
-        # jobs, and a superset activity only costs skipped-tile compute,
-        # never correctness — so the per-accept any()-reduction is saved.
-        def accept_reduce(choice, key, d, md, num_nodes, tile_act):
-            return pk.accept_reduce_pallas(
-                choice, key, d, md, num_nodes, tile_act, interpret=interp
-            )
-
-        def accept_flags(choice, key, fits_all, fits_win, win_key, tile_act):
-            return pk.accept_flags_pallas(
-                choice, key, fits_all, fits_win, win_key, tile_act,
-                interpret=interp,
+        # The accepts reuse the round's bid-activity tiles: bidders are
+        # a subset of bid-active jobs, and a superset activity only
+        # costs skipped-tile compute, never correctness. The verdict
+        # kernel folds totals + fit checks + consumed capacity into one
+        # sweep, feeding the flags kernel directly.
+        def accept_pass(choice, gpu_free, mem_free, act):
+            return pk.accept_phase_pallas(
+                choice, accept_key, jobs.gpu_demand, jobs.mem_demand,
+                gpu_free, mem_free, act, interpret=interp,
             )
 
         def fence_minrank(gf, mf, rankf_eff):
@@ -645,8 +629,7 @@ def solve_greedy(
                 q_lo, q_scale, q_max, node_idx_bits,
             )
 
-        accept_reduce = None
-        accept_flags = None
+        accept_pass = None
 
         def fence_minrank(gf, mf, rankf_eff):
             return _fence_minrank(
@@ -696,11 +679,15 @@ def solve_greedy(
             has1 = prim != BIG
             choice1 = jnp.where(has1, prim & node_mask, N)
 
-            accept1, used_g1, used_m1 = _dense_accept(
-                choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
-                gpu_free, mem_free, N, accept_reduce=accept_reduce,
-                accept_flags=accept_flags, tile_act=act,
-            )
+            if accept_pass is not None:
+                accept1, used_g1, used_m1 = accept_pass(
+                    choice1, gpu_free, mem_free, act
+                )
+            else:
+                accept1, used_g1, used_m1 = _dense_accept(
+                    choice1, accept_key, jobs.gpu_demand, jobs.mem_demand,
+                    gpu_free, mem_free, N,
+                )
             assigned = jnp.where(accept1, choice1, assigned)
             gpu_free = gpu_free - used_g1
             mem_free = mem_free - used_m1
@@ -723,11 +710,15 @@ def solve_greedy(
             )
             retry = has1 & ~accept1 & (alt != BIG) & ~home_bid
             choice2 = jnp.where(retry, alt & node_mask, N)
-            accept2, used_g2, used_m2 = _dense_accept(
-                choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
-                gpu_free, mem_free, N, accept_reduce=accept_reduce,
-                accept_flags=accept_flags, tile_act=act,
-            )
+            if accept_pass is not None:
+                accept2, used_g2, used_m2 = accept_pass(
+                    choice2, gpu_free, mem_free, act
+                )
+            else:
+                accept2, used_g2, used_m2 = _dense_accept(
+                    choice2, accept_key, jobs.gpu_demand, jobs.mem_demand,
+                    gpu_free, mem_free, N,
+                )
             assigned = jnp.where(accept2, choice2, assigned)
             # Progress: any bid implies >=1 accept (a contested node's
             # winner in the first pass always fits — it bid against these
@@ -867,12 +858,13 @@ def _gang_repair(p: Problem, assigned: jax.Array):
     return assigned, nodes.gpu_free - used_gpu, nodes.mem_free - used_mem
 
 
-@functools.partial(jax.jit, static_argnames=("max_iters",))
+@functools.partial(jax.jit, static_argnames=("max_iters", "accel"))
 def solve_auction(
     p: Problem,
     weights: ScoreWeights = ScoreWeights(),
     eps: float = 0.01,
     max_iters: int = 512,
+    accel: str = "auto",
 ) -> Assignment:
     """Auction assignment (policy ``jax-auction``): one replica per node.
 
@@ -985,7 +977,10 @@ def solve_auction(
             jobs=_replace(jobs, valid=fillable),
             nodes=_replace(nodes, gpu_free=gpu_free, mem_free=mem_free),
         )
-        out = solve_greedy(sub, weights)
+        # accel threads through: a GSPMD-sharded auction caller passes
+        # 'jnp' (sharded.py) and the fill must not embed Pallas kernels,
+        # which cannot partition under GSPMD (advisor r3)
+        out = solve_greedy(sub, weights, accel=accel)
         assigned = jnp.where(
             fillable & (out.node >= 0), out.node, assigned
         )
@@ -1015,7 +1010,7 @@ def solve(
     ``_resolve_accel``); GSPMD-sharded callers must pass ``'jnp'``.
     """
     if policy == "jax-auction":
-        return solve_auction(p, weights)
+        return solve_auction(p, weights, accel=accel)
     if policy == "jax-greedy":
         return solve_greedy(p, weights, accel=accel)
     raise ValueError(

@@ -16,13 +16,13 @@ defeat reduction fusion. Here each round becomes:
   The fence minimum over ALL jobs (``minrank``) therefore arrives as an
   input (it only reads vectors; the caller computes it as a fused jnp
   reduction).
-- TWO ``accept`` kernel calls (first chance + second chance): per-node
-  column reductions (bidder demand totals + fused-key winner) whose inputs
-  are four [J] vectors; the [TILE_N, TILE_J] broadcast lives only in VMEM,
-  accumulating across J tiles (innermost grid dim, init at tile 0).
-- ONE ``accept flags`` kernel per accept call: the per-job accept bit
-  (``core._dense_accept``'s [N, J] broadcast-compare + any), which under
-  plain XLA is a second full [N, J] VPU pass per accept.
+- TWO ``accept`` passes (first chance + second chance), each a verdict
+  kernel (per-node bidder totals + winner + fit verdicts + consumed
+  capacity in one sweep — the [TILE_N, TILE_J] broadcast lives only in
+  VMEM, accumulating across J tiles) feeding a ``flags`` kernel (the
+  per-job accept bit, ``core._dense_accept``'s [N, J] broadcast-compare
+  + any). Fusing the fit/consumed [N]-vector math into the verdict sweep
+  removes ~6 XLA fusions per accept from the dispatch-bound round.
 - ONE ``fence`` kernel: the per-node fence minimum (``core._fence_minrank``),
   an [N, J] feasibility broadcast + rank min — under XLA another full
   [N, J] VPU pass per round even though its inputs are vectors.
@@ -289,34 +289,42 @@ def bid_reduce_pallas(
     )
 
 
-def _accept_kernel(
-    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has bidders
+def _accept_verdict_kernel(
+    act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile may hold bidders
     ch_ref,  # [1, TILE_J] i32 chosen node (N = no bid)
     key_ref,  # [1, TILE_J] i32 accept key
-    d_ref,  # [1, TILE_J] f32
-    md_ref,  # [1, TILE_J] f32
-    tg_ref,  # [TILE_N, 1] f32 out: bidder gpu total
-    tm_ref,  # [TILE_N, 1] f32 out: bidder mem total
+    d_ref,  # [1, TILE_J] f32 gpu demand
+    md_ref,  # [1, TILE_J] f32 mem demand
+    gf_ref,  # [TILE_N, 1] f32 gpu free (the capacities bids fit against)
+    mf_ref,  # [TILE_N, 1] f32 mem free
+    ug_ref,  # [TILE_N, 1] f32 out: capacity consumed (gpu)
+    um_ref,  # [TILE_N, 1] f32 out: capacity consumed (mem)
+    okall_ref,  # [TILE_N, 1] i32 out: node accepts all bidders
+    okwin_ref,  # [TILE_N, 1] i32 out: node accepts its winner
     win_ref,  # [TILE_N, 1] i32 out: winning key
-    wd_ref,  # [TILE_N, 1] f32 out: winner's gpu demand
-    wmd_ref,  # [TILE_N, 1] f32 out: winner's mem demand
+    tg_scr,  # [TILE_N, 1] f32 scratch: bidder gpu total
+    tm_scr,  # [TILE_N, 1] f32 scratch
+    wd_scr,  # [TILE_N, 1] f32 scratch: winner gpu demand
+    wmd_scr,  # [TILE_N, 1] f32 scratch
+    *,
+    tiles_j: int,
 ):
+    """Accept totals + fit verdicts + consumed capacity in ONE sweep —
+    the accept_reduce kernel plus the ~6 inter-kernel [N]-vector fusions
+    (fits_all/fits_win/used_*) that each cost dispatch latency in the
+    round's critical path (docs/PROFILING.md: the solve is
+    dispatch-bound, not bandwidth-bound)."""
     tn = pl.program_id(0)
     tj = pl.program_id(1)
     big = jnp.int32(_I32MAX)
 
-    # tj is the innermost grid dim: initialize at the first J tile, then
-    # accumulate — the output block index is tj-independent, so Mosaic
-    # keeps it resident in VMEM across the J sweep. Init happens whether
-    # or not tile 0 is active; a bidder-free tile contributes zero demand
-    # and a BIG key, so skipping its broadcast-compare is exact.
     @pl.when(tj == 0)
     def _init():
-        tg_ref[:] = jnp.zeros_like(tg_ref)
-        tm_ref[:] = jnp.zeros_like(tm_ref)
+        tg_scr[:] = jnp.zeros_like(tg_scr)
+        tm_scr[:] = jnp.zeros_like(tm_scr)
         win_ref[:] = jnp.full_like(win_ref, big)
-        wd_ref[:] = jnp.zeros_like(wd_ref)
-        wmd_ref[:] = jnp.zeros_like(wmd_ref)
+        wd_scr[:] = jnp.zeros_like(wd_scr)
+        wmd_scr[:] = jnp.zeros_like(wmd_scr)
 
     @pl.when(act_ref[tj] != 0)
     def _accum():
@@ -325,64 +333,93 @@ def _accept_kernel(
         n_glob = tn * TILE_N + jax.lax.broadcasted_iota(
             jnp.int32, (TILE_N, ch.shape[1]), 0
         )
-        mine = ch == n_glob  # [TILE_N, TILE_J]; N sentinel matches no node
+        mine = ch == n_glob
         tg = jnp.sum(jnp.where(mine, d_ref[:], 0.0), axis=1, keepdims=True)
         tm = jnp.sum(jnp.where(mine, md_ref[:], 0.0), axis=1, keepdims=True)
         win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
-        # Winner demand rides the reduction: selecting the NEW running
-        # minimum's row (winner mask) costs one extra compare + two
-        # masked sums per tile, and saves _dense_accept's [N]-from-[J]
-        # winner-demand gather on the Pallas path.
         new_win = jnp.minimum(win_ref[:], win)
         winner = mine & (key == new_win)
         wd = jnp.sum(jnp.where(winner, d_ref[:], 0.0), axis=1, keepdims=True)
-        wmd = jnp.sum(jnp.where(winner, md_ref[:], 0.0), axis=1, keepdims=True)
+        wmd = jnp.sum(
+            jnp.where(winner, md_ref[:], 0.0), axis=1, keepdims=True
+        )
         take = win < win_ref[:]
-        tg_ref[:] = tg_ref[:] + tg
-        tm_ref[:] = tm_ref[:] + tm
+        tg_scr[:] = tg_scr[:] + tg
+        tm_scr[:] = tm_scr[:] + tm
         win_ref[:] = new_win
-        wd_ref[:] = jnp.where(take, wd, wd_ref[:])
-        wmd_ref[:] = jnp.where(take, wmd, wmd_ref[:])
+        wd_scr[:] = jnp.where(take, wd, wd_scr[:])
+        wmd_scr[:] = jnp.where(take, wmd, wmd_scr[:])
+
+    @pl.when(tj == tiles_j - 1)
+    def _verdicts():
+        gf = gf_ref[:]
+        mf = mf_ref[:]
+        fits_all = (tg_scr[:] <= gf + _EPS) & (tm_scr[:] <= mf + _EPS)
+        has_win = win_ref[:] != big
+        fits_win = (
+            has_win
+            & (wd_scr[:] <= gf + _EPS)
+            & (wmd_scr[:] <= mf + _EPS)
+        )
+        okall_ref[:] = fits_all.astype(jnp.int32)
+        okwin_ref[:] = fits_win.astype(jnp.int32)
+        ug_ref[:] = jnp.where(
+            fits_all, tg_scr[:], jnp.where(fits_win, wd_scr[:], 0.0)
+        )
+        um_ref[:] = jnp.where(
+            fits_all, tm_scr[:], jnp.where(fits_win, wmd_scr[:], 0.0)
+        )
 
 
-def accept_reduce_pallas(
-    choice: jax.Array,  # i32[J]
+def accept_phase_pallas(
+    choice: jax.Array,  # i32[J] chosen node (N sentinel = no bid)
     accept_key: jax.Array,  # i32[J]
     d: jax.Array,  # f32[J]
     md: jax.Array,  # f32[J]
-    num_nodes: int,
-    tile_act: jax.Array,  # i32[tiles_j] 1 = tile has bidders
+    gpu_free: jax.Array,  # f32[N]
+    mem_free: jax.Array,  # f32[N]
+    tile_act: jax.Array,  # i32[tiles_j]
     *,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Per-node (gpu total, mem total, winner key, winner gpu, winner mem)
-    over bidders."""
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(accept bool[J], used_gpu f32[N], used_mem f32[N]) for one accept
+    pass: the verdict kernel (totals + fits + consumed capacity in one
+    sweep) feeds the flags kernel directly — no [N]-vector glue between
+    launches. Parity twin of core._dense_accept."""
     J = choice.shape[0]
-    _require_aligned(num_nodes, J)
-    tiles_n = num_nodes // TILE_N
+    N = gpu_free.shape[0]
+    _require_aligned(N, J)
+    tiles_n = N // TILE_N
     tile_j = _tile_j(J)
     tiles_j = J // tile_j
     row = pl.BlockSpec(
         (1, tile_j), lambda tn, tj, act: (0, tj), memory_space=pltpu.VMEM
     )
-    col_out = pl.BlockSpec(
+    col = pl.BlockSpec(
         (TILE_N, 1), lambda tn, tj, act: (tn, 0), memory_space=pltpu.VMEM
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(tiles_n, tiles_j),
-        in_specs=[row, row, row, row],
-        out_specs=[col_out, col_out, col_out, col_out, col_out],
+        in_specs=[row, row, row, row, col, col],
+        out_specs=[col] * 5,
+        scratch_shapes=[
+            pltpu.VMEM((TILE_N, 1), jnp.float32),
+            pltpu.VMEM((TILE_N, 1), jnp.float32),
+            pltpu.VMEM((TILE_N, 1), jnp.float32),
+            pltpu.VMEM((TILE_N, 1), jnp.float32),
+        ],
     )
-    tg, tm, win, wd, wmd = pl.pallas_call(
-        _accept_kernel,
+    kern = functools.partial(_accept_verdict_kernel, tiles_j=tiles_j)
+    ug, um, okall, okwin, win = pl.pallas_call(
+        kern,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
-            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
-            jax.ShapeDtypeStruct((num_nodes, 1), jnp.int32),
-            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
-            jax.ShapeDtypeStruct((num_nodes, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
         ],
         interpret=interpret,
     )(
@@ -391,8 +428,14 @@ def accept_reduce_pallas(
         accept_key.reshape(1, J),
         d.reshape(1, J),
         md.reshape(1, J),
+        gpu_free.reshape(N, 1),
+        mem_free.reshape(N, 1),
     )
-    return tg[:, 0], tm[:, 0], win[:, 0], wd[:, 0], wmd[:, 0]
+    accept = accept_flags_pallas(
+        choice, accept_key, okall[:, 0], okwin[:, 0], win[:, 0], tile_act,
+        interpret=interpret,
+    )
+    return accept, ug[:, 0], um[:, 0]
 
 
 def _bid_select_kernel(

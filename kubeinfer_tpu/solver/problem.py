@@ -334,31 +334,117 @@ def packed_words(J: int, N: int) -> int:
     return 7 * J + 6 * N + N * _CACHED_WORDS
 
 
-def pack_problem_arrays(**kwargs) -> tuple[np.ndarray, int, int, int, int]:
+def pack_problem_arrays(
+    *,
+    job_gpu: np.ndarray,
+    job_mem_gib: np.ndarray,
+    job_priority: np.ndarray | None = None,
+    job_gang: np.ndarray | None = None,
+    job_model: np.ndarray | None = None,
+    job_current_node: np.ndarray | None = None,
+    node_gpu_free: np.ndarray,
+    node_mem_free_gib: np.ndarray,
+    node_gpu_capacity: np.ndarray | None = None,
+    node_mem_capacity_gib: np.ndarray | None = None,
+    node_topology: np.ndarray | None = None,
+    node_cached: np.ndarray | None = None,
+    job_multiple: int = 1,
+    node_multiple: int = 1,
+    job_perm: np.ndarray | None = None,
+) -> tuple[np.ndarray, int, int, int, int]:
     """Host-side packing; same kwargs as ``encode_problem_arrays``.
 
     Returns ``(buf f32[packed_words], J_true, N_true, J, N)``.
+
+    Fields are written DIRECTLY into their buffer slices (one zeroed
+    allocation, one copy per field) rather than materializing 14 padded
+    intermediates and copying them again — the pack sits inside the
+    headline pack+solve latency, and the double-copy was ~half its cost.
+    ``job_perm`` applies the backend's priority permutation during the
+    field copy (see backends.py).
     """
-    jobs, nodes, J_true, N_true, J, N = _prep_padded_arrays(**kwargs)
+    J_true = int(job_gpu.shape[0])
+    N_true = int(node_gpu_free.shape[0])
+    J = bucket_size(max(J_true, 1))
+    N = bucket_size(max(N_true, 1))
+    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
+    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+
+    # np.empty + explicit pad fills: np.zeros would page-fault the whole
+    # buffer lazily on first write; the pad tails are a fraction of it
     buf = np.empty(packed_words(J, N), np.float32)
     i32 = buf.view(np.int32)
-    o = 0
-    for k in ("gpu_demand", "mem_demand", "priority"):
-        buf[o : o + J] = jobs[k]
-        o += J
-    for k in ("gang_id", "model_id", "current_node"):
-        i32[o : o + J] = jobs[k]
-        o += J
-    i32[o : o + J] = jobs["valid"]
-    o += J
-    for k in ("gpu_free", "mem_free", "gpu_capacity", "mem_capacity"):
-        buf[o : o + N] = nodes[k]
-        o += N
-    i32[o : o + N] = nodes["topology"]
-    o += N
-    i32[o : o + N] = nodes["valid"]
-    o += N
-    buf[o:].view(np.uint8)[:] = nodes["cached"].reshape(-1)
+
+    def putf(o, a, pad=0.0):
+        dst = buf[o : o + J]
+        dst[J_true:] = pad
+        if a is None:
+            dst[:J_true] = pad
+        else:
+            a = np.asarray(a)
+            dst[:J_true] = a[job_perm] if job_perm is not None else a
+
+    putf(0, job_gpu)
+    putf(J, job_mem_gib)
+    putf(2 * J, job_priority)
+    gang = i32[3 * J : 4 * J]
+    gang[J_true:] = -1
+    if job_gang is not None:
+        gang[:J_true] = _densify_gangs(
+            np.asarray(job_gang, np.int32)[job_perm]
+            if job_perm is not None
+            else np.asarray(job_gang, np.int32)
+        )
+    else:
+        gang[:J_true] = -1
+    model = i32[4 * J : 5 * J]
+    model[J_true:] = 0
+    if job_model is not None:
+        jm = np.asarray(job_model)
+        if job_perm is not None:
+            jm = jm[job_perm]
+        # out-of-table slots collapse to 0 ("no affinity") — see
+        # encode_problem_arrays
+        model[:J_true] = np.where((jm >= 0) & (jm < MAX_MODELS), jm, 0)
+    else:
+        model[:J_true] = 0
+    cur = i32[5 * J : 6 * J]
+    cur[:] = -1
+    if job_current_node is not None:
+        jc = np.asarray(job_current_node, np.int32)
+        cur[:J_true] = jc[job_perm] if job_perm is not None else jc
+    jv = i32[6 * J : 7 * J]
+    jv[:J_true] = 1
+    jv[J_true:] = 0
+    o = 7 * J
+
+    def putn(off, a, fallback=None):
+        dst = buf[off : off + N]
+        dst[N_true:] = 0.0
+        dst[:N_true] = a if a is not None else fallback
+
+    putn(o, node_gpu_free)
+    putn(o + N, node_mem_free_gib)
+    putn(o + 2 * N, node_gpu_capacity, node_gpu_free)
+    putn(o + 3 * N, node_mem_capacity_gib, node_mem_free_gib)
+    topo = i32[o + 4 * N : o + 5 * N]
+    topo[N_true:] = 0
+    if node_topology is not None:
+        topo[:N_true] = node_topology
+    else:
+        topo[:N_true] = 0
+    nv = i32[o + 5 * N : o + 6 * N]
+    nv[:N_true] = 1
+    nv[N_true:] = 0
+    cached = buf[o + 6 * N :].view(np.uint8).reshape(N, MAX_MODELS)
+    if node_cached is not None:
+        nc = np.asarray(node_cached)
+        w = nc.shape[1]
+        cached[:N_true, :w] = nc
+        cached[:N_true, w:] = 0
+        cached[N_true:] = 0
+    else:
+        cached[:] = 0
     return buf, J_true, N_true, J, N
 
 
