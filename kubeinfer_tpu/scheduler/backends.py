@@ -114,6 +114,10 @@ def _descending_stable_perm(pr: np.ndarray) -> np.ndarray:
     f32 argsort. Output is identical to ``np.argsort(-pr,
     kind="stable")`` in all cases.
     """
+    if not np.isfinite(pr).all():
+        # NaN/inf priorities: the int cast below would emit a numpy
+        # RuntimeWarning per solve; mergesort handles them directly
+        return np.argsort(-pr, kind="stable")
     pi = pr.astype(np.int64)
     if (pi == pr).all():
         lo, hi = int(pi.min()), int(pi.max())

@@ -647,9 +647,12 @@ def solve_greedy(
         """
 
         def cond(state):
+            # `progress` already conjoins last round's accepts with the
+            # post-round pending check (computed in body, where it fuses
+            # with neighboring ops — a separate reduce here would cost
+            # its own dispatch per iteration)
             assigned, gpu_free, mem_free, rounds, progress = state
-            pending = jnp.any((assigned < 0) & jobs.valid)
-            return progress & pending & (rounds < round_cap)
+            return progress & (rounds < round_cap)
 
         def body(state):
             assigned, gpu_free, mem_free, rounds, _ = state
@@ -729,12 +732,16 @@ def solve_greedy(
                 gpu_free - used_g2,
                 mem_free - used_m2,
                 rounds + 1,
-                jnp.any(accept1) | jnp.any(accept2),
+                (jnp.any(accept1) | jnp.any(accept2))
+                & jnp.any((assigned < 0) & jobs.valid),
             )
 
         return lax.while_loop(
             cond, body,
-            (assigned, gpu_free, mem_free, rounds0, jnp.bool_(True)),
+            # initial progress = anything pending at all (one-time
+            # reduce; keeps the no-op invocation at zero rounds)
+            (assigned, gpu_free, mem_free, rounds0,
+             jnp.any((assigned < 0) & jobs.valid)),
         )
 
     assigned, gpu_free, mem_free, rounds, _ = run_rounds(

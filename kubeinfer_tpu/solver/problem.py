@@ -162,6 +162,26 @@ def _densify_gangs(gang: np.ndarray) -> np.ndarray:
     return out
 
 
+def _padded_sizes(
+    J_true: int, N_true: int, job_multiple: int, node_multiple: int
+) -> tuple[int, int]:
+    """Bucketed padded axis sizes, rounded up to the mesh-axis multiples —
+    shared by the dict-based and direct-pack encoders so their layouts
+    can never desync."""
+    J = bucket_size(max(J_true, 1))
+    N = bucket_size(max(N_true, 1))
+    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
+    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+    return J, N
+
+
+def _clamp_model_ids(jm: np.ndarray) -> np.ndarray:
+    """Out-of-table model slots collapse to 0 ("no affinity") rather than
+    letting a downstream clip manufacture false cache hits for whichever
+    model owns slot MAX_MODELS-1."""
+    return np.where((jm >= 0) & (jm < MAX_MODELS), jm, 0)
+
+
 def _prep_padded_arrays(
     *,
     job_gpu: np.ndarray,
@@ -189,10 +209,7 @@ def _prep_padded_arrays(
     """
     J_true = int(job_gpu.shape[0])
     N_true = int(node_gpu_free.shape[0])
-    J = bucket_size(max(J_true, 1))
-    N = bucket_size(max(N_true, 1))
-    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
-    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+    J, N = _padded_sizes(J_true, N_true, job_multiple, node_multiple)
 
     def padj(a, fill, dtype):
         out = np.full(J, fill, dtype)
@@ -233,7 +250,7 @@ def _prep_padded_arrays(
             # Out-of-table slots collapse to 0 ("no affinity") rather than
             # letting jnp.take's clip manufacture false cache hits for
             # whichever model owns slot MAX_MODELS-1.
-            np.where((job_model >= 0) & (job_model < MAX_MODELS), job_model, 0)
+            _clamp_model_ids(np.asarray(job_model))
             if job_model is not None
             else np.zeros(J_true),
             0, np.int32,
@@ -365,10 +382,7 @@ def pack_problem_arrays(
     """
     J_true = int(job_gpu.shape[0])
     N_true = int(node_gpu_free.shape[0])
-    J = bucket_size(max(J_true, 1))
-    N = bucket_size(max(N_true, 1))
-    J = -(-J // max(job_multiple, 1)) * max(job_multiple, 1)
-    N = -(-N // max(node_multiple, 1)) * max(node_multiple, 1)
+    J, N = _padded_sizes(J_true, N_true, job_multiple, node_multiple)
 
     # np.empty + explicit pad fills: np.zeros would page-fault the whole
     # buffer lazily on first write; the pad tails are a fraction of it
@@ -403,9 +417,7 @@ def pack_problem_arrays(
         jm = np.asarray(job_model)
         if job_perm is not None:
             jm = jm[job_perm]
-        # out-of-table slots collapse to 0 ("no affinity") — see
-        # encode_problem_arrays
-        model[:J_true] = np.where((jm >= 0) & (jm < MAX_MODELS), jm, 0)
+        model[:J_true] = _clamp_model_ids(jm)
     else:
         model[:J_true] = 0
     cur = i32[5 * J : 6 * J]
