@@ -11,12 +11,13 @@ the mandated serial scorer is the anchor).
 
 Both headline terms are direct measurements, not subtractions: pack time
 is host-side wall clock, and the device solve is the difference of two
-on-device solve *chains* (k=4 vs k=20 solves in one dispatch), which
+on-device solve *chains* (k=8 vs k=80 solves in one dispatch), which
 cancels the transport term exactly. This matters because this bench
 environment reaches its TPU through a remote PJRT relay (the axon
 tunnel): every dispatch+readback pays a ~90-130ms transport round trip
-with ~±20ms jitter that no software change can remove and that local
-attachment (~0.1ms dispatch) does not pay. The relay-inclusive
+with jitter no software change can remove (±1ms in r2; spikes to
+±40-57ms observed in r3) and that local attachment (~0.1ms dispatch)
+does not pay. The relay-inclusive
 end-to-end p50 is still reported in extras (``relay_e2e_p50_ms``) along
 with the measured transport floor and jitter, so nothing is hidden.
 
@@ -122,17 +123,17 @@ def _chained_solver(req, k):
     return chained, p
 
 
-def device_solve_ms(req, k_short=4, k_long=40, reps=5):
+def device_solve_ms(req, k_short=8, k_long=80, reps=7):
     """Pure device-compute per-solve time via chain differencing.
 
     Times a k_short-solve chain and a k_long-solve chain (each ONE
     dispatch+readback) and reports (t_long - t_short) / (k_long -
     k_short): the transport round trip appears identically in both and
-    cancels exactly, unlike floor-subtraction (transport jitter is
-    ~±20ms here, larger than the whole signal). The 36-solve spread
-    keeps the differenced signal (~130ms at 10k x 1k) well above relay
-    jitter spikes (observed up to ~50ms), which at a narrower spread
-    moved the reported number by +-2ms between runs.
+    cancels exactly, unlike floor-subtraction (transport jitter here is
+    larger than the whole signal). The 72-solve spread — widened from 36
+    in r3 when relay jitter degraded to ±40-57ms spikes — keeps the
+    differenced signal (~170ms at 10k x 1k) well above the spikes; at
+    narrower spreads the reported number moved ±0.2ms between runs.
     Also returns the median one-dispatch floor for reporting.
     """
     import jax
@@ -334,8 +335,8 @@ def main() -> None:
     jax_stats = time_backend(jax_backend, req, reps)
     native_stats = time_backend(native, req, max(reps // 2, 3))
     dev_ms, floor_ms, floor_jitter_ms = device_solve_ms(
-        req, k_short=2 if args.quick else 4, k_long=10 if args.quick else 40,
-        reps=3 if args.quick else 5,
+        req, k_short=2 if args.quick else 8, k_long=10 if args.quick else 80,
+        reps=3 if args.quick else 7,
     )
 
     # Headline: pack + device solve — the local-attachment latency (both
