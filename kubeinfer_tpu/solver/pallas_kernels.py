@@ -594,6 +594,426 @@ def fence_minrank_pallas(
     return out[:, 0]
 
 
+# --- Round-fusion mega-kernel (class-serialized greedy) ---------------------
+#
+# The pipelined round loop above is dispatch-bound, not bandwidth-bound
+# (docs/PROFILING.md): ~47 XLA fusions + 7 Pallas launches per round at
+# ~170-195us/round, of which the actual S traffic is ~15-25us. The fix is to
+# stop paying per-round launches at all: serialize the priority fence classes
+# (the job axis arrives priority-sorted from backends.py, so a fence class is
+# a contiguous column window) and run EVERY settlement round of a class
+# inside one grid step of ONE pallas_call, with the class's S window resident
+# in VMEM and the capacity vectors resident across grid steps.
+#
+# Windows are VMEM-budget-sized, not priority-aligned, so a window can mix
+# priority levels; the per-node fence therefore still runs INSIDE the
+# window — as one [N,1] reduce over the resident block per round, costing
+# nothing next to the old standalone fence kernel + launch. Cross-window
+# inversion is prevented by the serialization itself (earlier windows hold
+# all strictly-higher priority ranks when the job axis is sorted). The
+# separate fence kernel, its launch, and the activity vectors disappear;
+# the home-bid fence exemption is dropped deliberately. The result is NOT
+# bit-identical to the pipelined algorithm (later windows see
+# post-settlement capacities instead of bidding early on unfenced nodes —
+# if anything a closer match to serial FFD, and dropping the exemption
+# removes the one priority inversion the old path allowed: a low-priority
+# incumbent's early home-grab deflecting a high-priority bidder). It keeps
+# the same hard guarantees: no overcommit ever, at exit no unplaced job
+# finds any node feasible (capacities only shrink, so earlier windows'
+# fixpoints survive later consumption), and no job is fenced out by an
+# equal-or-lower rank.
+#
+# Parity contract: the kernel body and the pure-jnp twin (mega_rounds_jnp)
+# share _mega_round_math, so interpret-mode output is bit-identical to the
+# twin by construction (f32 demand sums are dyadic rationals — order-safe).
+
+# VMEM budget for the resident S window. The round loop's live temporaries
+# (packed bids, masks, accept reductions) cost ~5x the S window itself, so
+# the whole kernel wants ~6-7x this in scoped VMEM — the explicit
+# vmem_limit below raises Mosaic's 16MB default (v5e has 128MB physical
+# VMEM; measured stack need at W=1024, N=1024 is ~27MB).
+# Measured on v5e at 10k x 1k (scripts/mega_timing.py), final fenced
+# kernel: W=1024 1.43ms / W=2048 ~1.20ms / W=3072 1.78ms — fewer, wider
+# windows amortize per-round reduction latency until the pass cost (and,
+# past W=2048, mixed-rank fence serialization) dominates. The fence-free
+# prototype ranked the same W ordering at 1.15 / 1.00 / 1.15.
+_MEGA_S_BYTES = 8 * 1024 * 1024
+_MEGA_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def mega_window(N: int, J: int) -> int | None:
+    """Class-window width for the mega path: the largest 128-multiple
+    dividing J whose [N, W] f32 S window fits the VMEM budget. None when
+    no window fits (huge N) — callers fall back to the pipelined path.
+
+    Unlike the tiled round kernels, mega takes the whole node axis in one
+    block, so N only needs f32 sublane alignment (N % 8), not TILE_N.
+    The one bucket below 128 (J=64) gets a single 64-wide window — a
+    twin/interpret-only shape (Mosaic lanes want 128; `_resolve_accel`
+    never routes it to the real kernel)."""
+    if N % 8:
+        return None
+    fit = _MEGA_S_BYTES // (4 * N)
+    wmax = min(J, fit // 128 * 128)
+    if J % 128 == 0 and wmax >= 128:
+        for w in range(wmax, 0, -128):
+            if J % w == 0:
+                return w
+    if J % 64 == 0 and fit >= J:
+        return J  # the one sub-128 bucket (J=64): a single window
+    return None  # N too large for any window: pipelined fallback
+
+
+def _mega_round_math(
+    Sq,  # [N, W] resident PRE-QUANTIZED cost window: (S - q_lo) * q_scale,
+    #      computed once per window entry — saves an [N, W] ALU pass per
+    #      round vs renormalizing S each time
+    d,  # [1, W] gpu demand
+    md,  # [1, W] mem demand
+    key,  # [1, W] i32 accept key (rank | demand desc | index)
+    rank,  # [1, W] f32 fence rank (class-compressed crank; RANK_INF for
+    #        invalid jobs)
+    may,  # [1, W] bool job may ever bid (valid)
+    asg,  # [1, W] i32 assigned node, -1 = unplaced
+    gf,  # [N, 1] gpu free (invalid nodes folded to -1)
+    mf,  # [N, 1] mem free
+    vg,  # [N, 1] fit-pressure weights (w_gpu / cap)
+    vm,  # [N, 1]
+    *,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+):
+    """One serialized-class settlement round on resident values.
+
+    Shared verbatim by the Mosaic kernel body and the jnp twin — parity by
+    construction. Returns (asg, gf, mf, progress): in-window per-node
+    priority fence (windows can mix fence classes — VMEM sizes them, not
+    priority boundaries), bid (packed masked min over nodes), per-node
+    joint-fit/winner accept (core._dense_accept's rule), capacity update.
+    ``progress`` is False at the window fixpoint — additionally cut short
+    when no unplaced demand fits the largest free node (saves the
+    all-infeasible discovery round on exhausted-capacity windows, e.g.
+    most of the 50k soak's tail)."""
+    big = jnp.int32(_I32MAX)
+    rank_inf = jnp.float32(RANK_INF)
+    N = Sq.shape[0]
+    unpl = may & (asg < 0)  # [1, W]
+    feas = (d <= gf + _EPS) & (md <= mf + _EPS) & unpl  # [N, W]
+    # Per-node fence over the resident window: job j may bid node n only
+    # if no unplaced higher-rank job finds n feasible. The [N, W] fence
+    # reduce only runs while the UNPLACED set actually spans more than
+    # one rank — [1, W] min/max reduces detect that per round, so
+    # single-class windows and straggler tails (conflict losers are
+    # almost always one rank) skip it entirely.
+    rank_eff = jnp.where(unpl, rank, rank_inf)
+    r_lo = jnp.min(rank_eff)
+    r_hi = jnp.max(jnp.where(unpl, rank, -rank_inf))
+    minrank = jax.lax.cond(
+        r_lo < r_hi,
+        lambda: jnp.min(
+            jnp.where(feas, rank_eff, rank_inf), axis=1, keepdims=True
+        ),
+        lambda: jnp.full((feas.shape[0], 1), rank_inf, jnp.float32),
+    )
+    feas = feas & (rank_eff <= minrank)
+    # live best-fit pressure, pre-scaled into quantized units ([N, 1])
+    uq = (vg * gf + vm * mf) * q_scale
+    q = jnp.clip(Sq + uq, 0.0, q_max)
+    n_glob = jax.lax.broadcasted_iota(jnp.int32, feas.shape, 0)
+    packed = jnp.where(feas, (q.astype(jnp.int32) << node_idx_bits) | n_glob, big)
+    prim = jnp.min(packed, axis=0, keepdims=True)  # [1, W]
+    node_mask = jnp.int32((1 << node_idx_bits) - 1)
+    choice = jnp.where(prim != big, prim & node_mask, jnp.int32(N))
+    mine = choice == n_glob  # [N, W]; sentinel N matches no row
+    tg = jnp.sum(jnp.where(mine, d, 0.0), axis=1, keepdims=True)  # [N, 1]
+    tm = jnp.sum(jnp.where(mine, md, 0.0), axis=1, keepdims=True)
+    win = jnp.min(jnp.where(mine, key, big), axis=1, keepdims=True)
+    fits_all = (tg <= gf + _EPS) & (tm <= mf + _EPS)
+    # Unlike the pipelined accept (whose second-chance pass re-checks
+    # against post-first-pass capacities), every mega bid is made against
+    # exactly the capacities this accept checks, so a contested node's
+    # single winner always fits — no separate winner-fit test. One ``ok``
+    # mask then drives the accept flags AND the consumed-capacity sums in
+    # the same sweep (the pipelined kernels need separate winner-demand
+    # reductions because their flags kernel runs in another launch).
+    ok = mine & (fits_all | (key == win))
+    accept = jnp.any(ok, axis=0, keepdims=True)
+    used_g = jnp.sum(jnp.where(ok, d, 0.0), axis=1, keepdims=True)
+    used_m = jnp.sum(jnp.where(ok, md, 0.0), axis=1, keepdims=True)
+    asg = jnp.where(accept, choice, asg)
+    gf = gf - used_g
+    mf = mf - used_m
+    # Fixpoint detection: accepts this round AND something still unplaced
+    # AND the smallest remaining gpu demand fits the roomiest node (a cheap
+    # O(N)+O(W) necessary condition for any further bid).
+    still = may & (asg < 0)
+    min_d = jnp.min(jnp.where(still, d, jnp.float32(3.4e38)))
+    progress = (
+        jnp.any(accept)
+        & jnp.any(still)
+        & (min_d <= jnp.max(gf) + _EPS)
+    )
+    return asg, gf, mf, progress
+
+
+def _mega_kernel(
+    d_ref,  # [1, W] f32 gpu demand (class window)
+    md_ref,  # [1, W] f32 mem demand
+    key_ref,  # [1, W] i32 accept key
+    rank_ref,  # [1, W] f32 fence rank (RANK_INF for invalid)
+    may_ref,  # [1, W] i32 job validity (1 = may bid)
+    gf0_ref,  # [N, 1] f32 starting gpu free (invalid nodes folded to -1)
+    mf0_ref,  # [N, 1] f32 starting mem free
+    vg_ref,  # [N, 1] f32 fit-pressure weights
+    vm_ref,  # [N, 1] f32
+    s_ref,  # [N, W] f32 resident cost window for this class
+    asg_ref,  # [1, W] i32 out: assigned node (-1 unplaced)
+    gf_ref,  # [N, 1] f32 out: free capacity, resident across classes
+    mf_ref,  # [N, 1] f32 out
+    rounds_ref,  # [1, 1] i32 out (SMEM): total settlement rounds
+    capped_ref,  # [1, 1] i32 out (SMEM): 1 = some window hit max_rounds
+    #              with progress still possible (budget exhaustion signal)
+    *,
+    max_rounds: int,
+    q_lo: float,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+):
+    c = pl.program_id(0)
+
+    @pl.when(c == 0)
+    def _init():
+        gf_ref[:] = gf0_ref[:]
+        mf_ref[:] = mf0_ref[:]
+        rounds_ref[0, 0] = 0
+        capped_ref[0, 0] = 0
+
+    d = d_ref[:]
+    md = md_ref[:]
+    key = key_ref[:]
+    rank = rank_ref[:]
+    may = may_ref[:] != 0
+    Sq = (s_ref[:] - q_lo) * q_scale  # once per window, not per round
+    vg = vg_ref[:]
+    vm = vm_ref[:]
+
+    def cond(carry):
+        _, _, _, r, prog = carry
+        return prog & (r < max_rounds)
+
+    def body(carry):
+        asg, gf, mf, r, _ = carry
+        asg, gf, mf, prog = _mega_round_math(
+            Sq, d, md, key, rank, may, asg, gf, mf, vg, vm,
+            q_scale=q_scale, q_max=q_max,
+            node_idx_bits=node_idx_bits,
+        )
+        return asg, gf, mf, r + jnp.int32(1), prog
+
+    gf_in = gf_ref[:]
+    mf_in = mf_ref[:]
+    asg0 = jnp.full(asg_ref.shape, -1, jnp.int32)
+    init_prog = jnp.any(may) & (
+        jnp.min(jnp.where(may, d, jnp.float32(3.4e38)))
+        <= jnp.max(gf_in) + _EPS
+    )
+    asg, gf, mf, r, prog = jax.lax.while_loop(
+        cond, body, (asg0, gf_in, mf_in, jnp.int32(0), init_prog)
+    )
+    asg_ref[:] = asg
+    gf_ref[:] = gf
+    mf_ref[:] = mf
+    rounds_ref[0, 0] = rounds_ref[0, 0] + r
+    # prog surviving the loop exit means the budget bound, not the
+    # fixpoint — the caller's repair/fill safety net keys off this.
+    capped_ref[0, 0] = capped_ref[0, 0] | prog.astype(jnp.int32)
+
+
+def mega_solve_pallas(
+    s_t: jax.Array,  # [N, J] resident cost field (priority-sorted J axis)
+    d: jax.Array,  # f32[J]
+    md: jax.Array,  # f32[J]
+    accept_key: jax.Array,  # i32[J]
+    rankf: jax.Array,  # f32[J] fence rank (RANK_INF for invalid)
+    may_bid: jax.Array,  # bool[J] (valid jobs)
+    gf_eff: jax.Array,  # f32[N] (invalid nodes folded to -1)
+    mf: jax.Array,  # f32[N]
+    vg: jax.Array,  # f32[N]
+    vm: jax.Array,  # f32[N]
+    *,
+    max_rounds: int,
+    q_lo: float,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Whole greedy main loop in ONE pallas_call.
+
+    Grid steps are contiguous windows of the priority-sorted job axis;
+    each step runs its window's settlement rounds to a fixpoint over the
+    VMEM-resident S window (with the per-node fence computed in-window)
+    while the capacity vectors stay resident in revisited output blocks.
+    Returns (assigned i32[J], gpu_free f32[N], mem_free f32[N],
+    rounds i32, capped bool). ``max_rounds`` is a PER-WINDOW budget;
+    ``capped`` reports any window exiting on it with progress still
+    possible. Twin: ``mega_rounds_jnp``.
+    """
+    N, J = s_t.shape
+    W = mega_window(N, J)
+    if W is None:
+        raise ValueError(f"no mega window for N={N} J={J}")
+    n_classes = J // W
+    row = pl.BlockSpec((1, W), lambda c: (0, c), memory_space=pltpu.VMEM)
+    const_col = pl.BlockSpec(
+        (N, 1), lambda c: (0, 0), memory_space=pltpu.VMEM
+    )
+    smem_scalar = pl.BlockSpec(
+        (1, 1), lambda c: (0, 0), memory_space=pltpu.SMEM
+    )
+    kern = functools.partial(
+        _mega_kernel,
+        max_rounds=max_rounds,
+        q_lo=q_lo,
+        q_scale=q_scale,
+        q_max=q_max,
+        node_idx_bits=node_idx_bits,
+    )
+    asg, gf, mfo, rounds, capped = pl.pallas_call(
+        kern,
+        grid=(n_classes,),
+        in_specs=[
+            row,  # d
+            row,  # md
+            row,  # key
+            row,  # rank
+            row,  # may
+            const_col,  # gf0
+            const_col,  # mf0
+            const_col,  # vg
+            const_col,  # vm
+            pl.BlockSpec((N, W), lambda c: (0, c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            row,
+            const_col,
+            const_col,
+            smem_scalar,
+            smem_scalar,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, J), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_MEGA_VMEM_LIMIT
+        ),
+    )(
+        d.reshape(1, J),
+        md.reshape(1, J),
+        accept_key.reshape(1, J),
+        rankf.reshape(1, J),
+        may_bid.astype(jnp.int32).reshape(1, J),
+        gf_eff.reshape(N, 1),
+        mf.reshape(N, 1),
+        vg.reshape(N, 1),
+        vm.reshape(N, 1),
+        s_t,
+    )
+    return asg[0], gf[:, 0], mfo[:, 0], rounds[0, 0], capped[0, 0] != 0
+
+
+def mega_rounds_jnp(
+    s_t: jax.Array,  # [N, J]
+    d: jax.Array,  # f32[J]
+    md: jax.Array,
+    accept_key: jax.Array,
+    rankf: jax.Array,
+    may_bid: jax.Array,
+    gf_eff: jax.Array,
+    mf: jax.Array,
+    vg: jax.Array,
+    vm: jax.Array,
+    *,
+    max_rounds: int,
+    q_lo: float,
+    q_scale: float,
+    q_max: float,
+    node_idx_bits: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Pure-jnp twin of ``mega_solve_pallas`` — identical class windows,
+    identical round math (shared _mega_round_math), bit-identical output.
+    The CPU/parity path for the class-serialized algorithm."""
+    N, J = s_t.shape
+    W = mega_window(N, J)
+    if W is None:
+        raise ValueError(f"no mega window for N={N} J={J}")
+    n_classes = J // W
+    d2 = d.reshape(1, J)
+    md2 = md.reshape(1, J)
+    key2 = accept_key.reshape(1, J)
+    rank2 = rankf.reshape(1, J)
+    may2 = may_bid.reshape(1, J)
+    gf0 = gf_eff.reshape(N, 1)
+    mf0 = mf.reshape(N, 1)
+    vg2 = vg.reshape(N, 1)
+    vm2 = vm.reshape(N, 1)
+
+    def class_body(c, carry):
+        asg_full, gf, mf_c, rounds, capped = carry
+        col = c * W
+        Sw = (
+            jax.lax.dynamic_slice(s_t, (0, col), (N, W)) - q_lo
+        ) * q_scale
+        dw = jax.lax.dynamic_slice(d2, (0, col), (1, W))
+        mdw = jax.lax.dynamic_slice(md2, (0, col), (1, W))
+        keyw = jax.lax.dynamic_slice(key2, (0, col), (1, W))
+        rankw = jax.lax.dynamic_slice(rank2, (0, col), (1, W))
+        mayw = jax.lax.dynamic_slice(may2, (0, col), (1, W))
+
+        def cond(carry):
+            _, _, _, r, prog = carry
+            return prog & (r < max_rounds)
+
+        def body(carry):
+            asg, gf, mf_c, r, _ = carry
+            asg, gf, mf_c, prog = _mega_round_math(
+                Sw, dw, mdw, keyw, rankw, mayw, asg, gf, mf_c, vg2, vm2,
+                q_scale=q_scale, q_max=q_max,
+                node_idx_bits=node_idx_bits,
+            )
+            return asg, gf, mf_c, r + jnp.int32(1), prog
+
+        init_prog = jnp.any(mayw) & (
+            jnp.min(jnp.where(mayw, dw, jnp.float32(3.4e38)))
+            <= jnp.max(gf) + _EPS
+        )
+        asg0 = jnp.full((1, W), -1, jnp.int32)
+        asg, gf, mf_c, r, prog = jax.lax.while_loop(
+            cond, body, (asg0, gf, mf_c, jnp.int32(0), init_prog)
+        )
+        asg_full = jax.lax.dynamic_update_slice(asg_full, asg, (0, col))
+        return asg_full, gf, mf_c, rounds + r, capped | prog
+
+    asg_full, gf, mf_out, rounds, capped = jax.lax.fori_loop(
+        0, n_classes, class_body,
+        (
+            jnp.full((1, J), -1, jnp.int32),
+            gf0,
+            mf0,
+            jnp.int32(0),
+            jnp.bool_(False),
+        ),
+    )
+    return asg_full[0], gf[:, 0], mf_out[:, 0], rounds, capped
+
+
 def _accept_flags_kernel(
     act_ref,  # i32[tiles_j] scalar-prefetch: 1 = tile has bidders
     ch_ref,  # [1, TILE_J] i32 chosen node (N = no bid)
