@@ -22,10 +22,18 @@ server's serialization boundary provides.
 from __future__ import annotations
 
 import copy
+import json
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable, Iterator
+
+# Journal entries between snapshot compactions. Control-plane mutation
+# rates are a few per tick, so compaction is rare; the journal stays
+# small enough that replay is never the startup bottleneck.
+SNAPSHOT_EVERY = 512
 
 
 class NotFoundError(KeyError):
@@ -66,13 +74,162 @@ class _Watcher:
 
 
 class Store:
-    """Thread-safe versioned object store with watch streams."""
+    """Thread-safe versioned object store with watch streams.
 
-    def __init__(self) -> None:
+    ``data_dir`` makes the store DURABLE — the etcd role the reference
+    gets for free from the API server (SURVEY §1 coordination plane;
+    every lease/CR semantic assumes objects outlive the process,
+    election.go:72-141, llmservice_controller.go:84-164): every mutation
+    appends one fsynced JSONL record to ``journal.jsonl``, compacted
+    into an atomically-renamed ``snapshot.json`` every SNAPSHOT_EVERY
+    records. On start, snapshot + journal replay restores both the
+    objects AND the resourceVersion counter — CAS continuity across
+    restarts is load-bearing (lease stealing compares the rv it read,
+    election.go:133-134; a reset counter would let a stale holder win).
+    Leases are replayed verbatim: the election's TTL check against
+    renewTime already classifies a dead leader's lease as expired, so a
+    restarted control plane converges without any special-casing.
+    """
+
+    def __init__(self, data_dir: str | os.PathLike | None = None) -> None:
         self._lock = threading.Lock()
         self._objects: dict[Key, dict[str, Any]] = {}
         self._rv = 0
         self._watchers: list[_Watcher] = []
+        self._data_dir = Path(data_dir) if data_dir else None
+        self._durable = self._data_dir is not None
+        self._journal_f = None
+        self._journal_n = 0
+        if self._data_dir is not None:
+            self._data_dir.mkdir(parents=True, exist_ok=True)
+            self._replay()
+            self._journal_f = open(
+                self._data_dir / "journal.jsonl", "a", encoding="utf-8"
+            )
+
+    # -- durability ------------------------------------------------------
+
+    def _replay(self) -> None:
+        """Restore objects + rv from snapshot.json then journal.jsonl.
+
+        Records at or below the snapshot's rv are skipped (a crash
+        between snapshot rename and journal rotation leaves pre-snapshot
+        records behind — rv makes replay idempotent). A torn final line
+        (crash mid-append) stops the replay at the last durable record.
+        """
+        snap_path = self._data_dir / "snapshot.json"
+        if snap_path.exists():
+            snap = json.loads(snap_path.read_text(encoding="utf-8"))
+            self._rv = int(snap["rv"])
+            for kind, ns, name, obj in snap["objects"]:
+                self._objects[Key(kind, ns, name)] = obj
+        jpath = self._data_dir / "journal.jsonl"
+        if not jpath.exists():
+            return
+        data = jpath.read_bytes()
+        good_end = 0
+        for line in data.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break  # torn tail from a crash mid-append
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            good_end += len(line)
+            if rec["rv"] <= self._rv:
+                continue
+            key = Key(rec["kind"], rec["ns"], rec["name"])
+            if rec["op"] == "delete":
+                self._objects.pop(key, None)
+            else:
+                self._objects[key] = rec["obj"]
+            self._rv = rec["rv"]
+            self._journal_n += 1
+        if good_end < len(data):
+            # Drop the torn/corrupt tail BEFORE reopening for append —
+            # appending after it would weld the next record onto the
+            # partial line and lose both on the following replay.
+            with open(jpath, "r+b") as f:
+                f.truncate(good_end)
+
+    def _append(
+        self, op: str, key: Key, rv: int, obj: dict[str, Any] | None
+    ) -> None:
+        """Journal one mutation (called under self._lock, AFTER the
+        in-memory mutation succeeded). Write+flush only — the fsync
+        happens in ``_sync`` AFTER the lock is released, so node
+        heartbeats at fleet scale (one update per node per interval on a
+        1k-node soak) pay their own disk latency without serializing
+        every concurrent get/list/watch behind it. Record order on disk
+        is still total (writes happen under the lock); the crash-loss
+        window is the mutations whose fsync hadn't completed — each
+        mutator only returns to ITS caller after its own fsync."""
+        if self._journal_f is None:
+            if self._durable:
+                raise RuntimeError(
+                    "durable store is closed; mutations would be lost "
+                    "on restart"
+                )
+            return
+        rec: dict[str, Any] = {
+            "op": op, "kind": key.kind, "ns": key.namespace,
+            "name": key.name, "rv": rv,
+        }
+        if obj is not None:
+            rec["obj"] = obj
+        self._journal_f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._journal_f.flush()
+        self._journal_n += 1
+        if self._journal_n >= SNAPSHOT_EVERY:
+            self._compact()
+
+    def _sync(self) -> None:
+        """fsync the journal outside the store lock (see _append). The
+        journal file can rotate (compaction) or close concurrently —
+        both leave the records already flushed durable via their own
+        fsync/close, so the raced handle is safely skipped."""
+        f = self._journal_f
+        if f is None:
+            return
+        try:
+            os.fsync(f.fileno())
+        except ValueError:  # rotated/closed between read and fsync
+            pass
+
+    def _compact(self) -> None:
+        """Write snapshot atomically (tmp + fsync + rename), then rotate
+        the journal. Crash-safe at every boundary: before the rename the
+        old snapshot+journal replay; after it, duplicate journal records
+        are skipped by rv."""
+        snap = {
+            "rv": self._rv,
+            "objects": [
+                [k.kind, k.namespace, k.name, o]
+                for k, o in self._objects.items()
+            ],
+        }
+        tmp = self._data_dir / "snapshot.json.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._data_dir / "snapshot.json")
+        self._journal_f.close()
+        self._journal_f = open(
+            self._data_dir / "journal.jsonl", "w", encoding="utf-8"
+        )
+        self._journal_n = 0
+
+    def close(self) -> None:
+        """Flush and close the journal. Further mutations on a durable
+        store raise (RuntimeError from _append) rather than silently
+        succeeding in memory only — an acknowledged-but-undurable write
+        is exactly the CAS-continuity hole the journal exists to close.
+        Call only at process shutdown, after all mutators stopped."""
+        with self._lock:
+            if self._journal_f is not None:
+                self._journal_f.close()
+                self._journal_f = None
 
     # -- helpers ---------------------------------------------------------
 
@@ -121,8 +278,11 @@ class Store:
             meta["resourceVersion"] = rv
             meta.setdefault("generation", 1)
             self._objects[key] = obj
+            self._append("create", key, rv, obj)
             self._notify("ADDED", kind, namespace, name, obj, rv)
-            return copy.deepcopy(obj)
+            out = copy.deepcopy(obj)
+        self._sync()
+        return out
 
     def get(self, kind: str, name: str, namespace: str = "default") -> dict[str, Any]:
         key = Key(kind, namespace, name)
@@ -153,8 +313,11 @@ class Store:
             rv = self._next_rv()
             meta["resourceVersion"] = rv
             self._objects[key] = obj
+            self._append("update", key, rv, obj)
             self._notify("MODIFIED", kind, namespace, name, obj, rv)
-            return copy.deepcopy(obj)
+            out = copy.deepcopy(obj)
+        self._sync()
+        return out
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         key = Key(kind, namespace, name)
@@ -163,7 +326,9 @@ class Store:
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             rv = self._next_rv()
+            self._append("delete", key, rv, None)
             self._notify("DELETED", kind, namespace, name, obj, rv)
+        self._sync()
 
     def list(self, kind: str, namespace: str | None = None) -> list[dict[str, Any]]:
         with self._lock:

@@ -296,13 +296,14 @@ class ContinuousEngine:
         self.cfg = cfg
         self.n_slots = n_slots
         self.cache_len = cache_len
-        # Optional SpeculativeEngine: a request arriving while the
-        # batcher is otherwise IDLE decodes through the draft instead of
-        # the slot machinery (speculation is a latency win exactly when
-        # there is nothing to batch with; under concurrent load the
-        # shared slots win on throughput, so busy periods never route
-        # here). Greedy requests keep token-identity; sampled requests
-        # keep the exact target distribution (speculative.py).
+        # Optional SpeculativeEngine: requests arriving while the
+        # batcher is otherwise IDLE decode through the draft instead of
+        # the slot machinery — including BATCHES of compatible greedy
+        # requests (the draft engine is row-batched, so concurrency no
+        # longer forfeits the draft speedup; see _drain_spec_group).
+        # Busy periods (occupied slots) keep slot batching. Greedy
+        # requests keep token-identity; sampled requests keep the exact
+        # target distribution (speculative.py).
         self.speculative = speculative
         self.spec_served = 0  # telemetry: requests served via the draft
         self._state = _init_state(
@@ -439,68 +440,130 @@ class ContinuousEngine:
             )
             req.done.set()
 
-    def _serve_speculative(self, req: "_Request") -> None:
-        """Serve one request synchronously through the speculative
-        engine (scheduler-thread context; the batcher is idle, so
-        blocking it costs nothing — new arrivals queue and get slot-
-        batched on the next loop iteration)."""
+    def _drain_spec_group(
+        self, first: "_Request"
+    ) -> tuple[list["_Request"], "_Request | None"]:
+        """Drain queued requests into ``first``'s greedy draft batch.
+
+        The speculative engine is batched (per-row cache offsets carry
+        rows advancing at different speeds), so concurrent greedy
+        requests need not lose the draft speedup to each other (r3
+        verdict item 8 — the old route required an EMPTY queue, so any
+        concurrency silently disabled speculation). Joinable: greedy
+        (temperature <= 0, so the shared scalar seed/warp parameters are
+        inert), no repetition penalty, same eos id, and every member
+        still fits the draft cache at the group's max_new high-water
+        mark. The first non-joinable request is returned as a holdover
+        for immediate slot admission — draining must not reorder it
+        behind later arrivals.
+        """
+        group = [first]
+        gmax = first.max_new
+        holdover: _Request | None = None
+        while len(group) < self.n_slots:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if nxt.cancelled.is_set():
+                nxt.done.set()
+                continue
+            cand_max = max(gmax, nxt.max_new)
+            if (
+                nxt.rep_penalty == 1.0
+                and nxt.temperature <= 0
+                and nxt.eos_id == first.eos_id
+                and all(
+                    self.speculative.fits(len(m.prompt), cand_max)
+                    for m in (*group, nxt)
+                )
+            ):
+                group.append(nxt)
+                gmax = cand_max
+            else:
+                holdover = nxt
+                break
+        return group, holdover
+
+    def _serve_speculative(self, group: list["_Request"]) -> None:
+        """Serve a batch of requests synchronously through the
+        speculative engine (scheduler-thread context; the batcher is
+        otherwise idle, so blocking it costs nothing — new arrivals
+        queue and get slot-batched on the next loop iteration). Rows
+        ride the group's max_new and are truncated back to their own
+        request's budget on the way out (a row past its own budget costs
+        ride-along rounds, never wrong tokens)."""
+        gmax = max(r.max_new for r in group)
+        first = group[0]
         try:
             out = self.speculative.generate(
-                [req.prompt], max_new_tokens=req.max_new,
-                eos_id=req.eos_id, temperature=req.temperature,
-                seed=req.seed, top_k=req.top_k, top_p=req.top_p,
+                [r.prompt for r in group], max_new_tokens=gmax,
+                eos_id=first.eos_id, temperature=first.temperature,
+                seed=first.seed, top_k=first.top_k, top_p=first.top_p,
             )
-            req.out_tokens.extend(
-                out.tokens[0, : out.lengths[0]].tolist()
-            )
-            self.spec_served += 1
-        except Exception as e:  # noqa: BLE001 — waiter must be released
-            req.failed = f"speculative decode failed: {e}"
-        req.done.set()
+            for b, r in enumerate(group):
+                n = min(int(out.lengths[b]), r.max_new)
+                r.out_tokens.extend(out.tokens[b, :n].tolist())
+                self.spec_served += 1
+        except Exception as e:  # noqa: BLE001 — waiters must be released
+            for r in group:
+                r.failed = f"speculative decode failed: {e}"
+        for r in group:
+            r.done.set()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            # admit as many pending requests as there are free slots
-            # (cancelled-before-admission requests are dropped)
             with self._lock:
-                admitted = False
+                busy = any(r is not None for r in self._slot_req)
+            if not busy:
+                # Idle: the queue head decides the route. A greedy
+                # draft-eligible head drains compatible followers into
+                # one draft batch (_drain_spec_group — r3 verdict item
+                # 8: a batched draft beats slots for uniformly-greedy
+                # bursts, both share the target's weights per forward
+                # but the draft cuts target passes ~(1-a^{k+1})/(1-a)x);
+                # a sampled head keeps the solo draft route only when
+                # nothing else waits (its rejection correction carries
+                # per-request warp/seed scalars); anything else goes to
+                # the slots.
+                try:
+                    req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if req.cancelled.is_set():
+                    req.done.set()
+                    continue
+                if (
+                    self.speculative is not None
+                    and req.rep_penalty == 1.0
+                    and self.speculative.fits(len(req.prompt), req.max_new)
+                ):
+                    if req.temperature <= 0:
+                        group, holdover = self._drain_spec_group(req)
+                        self._serve_speculative(group)
+                        if holdover is not None:
+                            with self._lock:
+                                self._admit(0, holdover)
+                        continue
+                    if self._queue.empty():
+                        self._serve_speculative([req])
+                        continue
+                with self._lock:
+                    self._admit(0, req)
+                continue
+            # busy: admit as many pending requests as there are free
+            # slots (cancelled-before-admission requests are dropped)
+            with self._lock:
                 for slot in range(self.n_slots):
                     if self._slot_req[slot] is None:
                         try:
-                            req = self._queue.get_nowait()
+                            nxt = self._queue.get_nowait()
                         except queue.Empty:
                             break
-                        if req.cancelled.is_set():
-                            req.done.set()
+                        if nxt.cancelled.is_set():
+                            nxt.done.set()
                             continue
-                        self._admit(slot, req)
-                        admitted = True
-                busy = any(r is not None for r in self._slot_req)
-            if not busy:
-                if not admitted:
-                    # idle: block briefly for work
-                    try:
-                        req = self._queue.get(timeout=0.05)
-                    except queue.Empty:
-                        continue
-                    if req.cancelled.is_set():
-                        req.done.set()
-                        continue
-                    # the single-slot speculative route: nothing to
-                    # batch with, so the draft's latency win is free
-                    if (
-                        self.speculative is not None
-                        and req.rep_penalty == 1.0
-                        and self._queue.empty()
-                        and self.speculative.fits(
-                            len(req.prompt), req.max_new
-                        )
-                    ):
-                        self._serve_speculative(req)
-                        continue
-                    with self._lock:
-                        self._admit(0, req)
-                continue
+                        self._admit(slot, nxt)
 
             # device step outside the lock (it can block on a compile;
             # stop() must still be able to fail over the slots)

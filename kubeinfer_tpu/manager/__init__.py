@@ -115,6 +115,10 @@ class ManagerConfig:
     health_bind_host: str = "127.0.0.1"
     health_bind_port: int = 18082  # ref --health-probe-bind-address
     store_connect: str = ""  # join external store instead of hosting
+    # durable state directory (journal + snapshots; store.py) — the etcd
+    # role. Empty = in-memory (tests, ephemeral demos). Ignored when
+    # joining an external store (its host owns durability).
+    data_dir: str = ""
     auth_token: str = ""
     tick_interval_s: float = 1.0
     node_ttl_s: float = 30.0
@@ -154,7 +158,7 @@ class Manager:
         else:
             from kubeinfer_tpu.scheduler.backends import solve_service_handler
 
-            self._local_store = Store()
+            self._local_store = Store(data_dir=cfg.data_dir or None)
             self.store_server = StoreServer(
                 self._local_store, cfg.store_bind_host, cfg.store_bind_port,
                 token=cfg.auth_token,
@@ -306,3 +310,6 @@ class Manager:
         self.metrics_server.shutdown()
         if self.store_server is not None:
             self.store_server.shutdown()
+            # hosted store: flush+close the durability journal (no-op
+            # for in-memory stores)
+            self._local_store.close()

@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store-connect", default="",
                    help="join an external store URL instead of hosting one "
                         "(HA standby topology; enables --leader-elect)")
+    p.add_argument("--data-dir", default="",
+                   help="directory for durable control-plane state "
+                        "(journal + snapshots); empty = in-memory only. "
+                        "The etcd role: services, workloads, nodes and "
+                        "leases survive a manager restart")
     p.add_argument("--metrics-bind-address", default="127.0.0.1:18081",
                    help="host:port for the /metrics endpoint")
     p.add_argument("--health-probe-bind-address", default="127.0.0.1:18082",
@@ -85,6 +90,7 @@ def main(argv: list[str] | None = None) -> int:
         metrics_bind_host=metrics_host, metrics_bind_port=metrics_port,
         health_bind_host=health_host, health_bind_port=health_port,
         store_connect=args.store_connect,
+        data_dir=args.data_dir,
         auth_token=token,
         tick_interval_s=args.tick_interval,
         node_ttl_s=args.node_ttl,

@@ -792,7 +792,8 @@ def solve_greedy(
         )
         assigned, gpu_free, mem_free, rounds, mega_capped = mega_fn(
             S, jobs.gpu_demand, jobs.mem_demand, accept_key, rankf,
-            jobs.valid, gf_valid, nodes.mem_free, v_g, v_m,
+            jobs.current_node, jobs.valid, gf_valid, nodes.mem_free,
+            v_g, v_m,
             max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
             q_max=q_max, node_idx_bits=node_idx_bits,
         )
@@ -832,10 +833,44 @@ def solve_greedy(
         )
         gf_fill = jnp.where(nodes.valid, gpu_free, -1.0)
         fillable = (assigned < 0) & jobs.valid & (jobs.gang_id < 0)
-        assigned, gpu_free, mem_free, rounds, _ = run_rounds(
-            assigned, gf_fill, mem_free, rounds, rankf_fill,
-            rounds + jnp.sum(fillable.astype(jnp.int32)) + 1,
-        )
+        if accel in ("mega", "mega-interpret", "mega-jnp"):
+            # Fill through the mega kernel too: at the 50k soak shape
+            # the pipelined fill (48 J tiles x several rounds) dominated
+            # the whole device solve. ``may_bid`` restricts bidding to
+            # the fillable set (mega always solves from an empty
+            # assignment, so non-fillable rows come back -1 and are
+            # merged over); the per-window cap is W+1 — every progress
+            # round places >= 1 job, so the in-kernel while reaches its
+            # fixpoint first, preserving the fill's completeness
+            # guarantee (a 64-cap could re-strand a node contested by
+            # more small jobs than the cap).
+            from kubeinfer_tpu.solver import pallas_kernels as pk
+
+            fill_fn = (
+                pk.mega_rounds_jnp
+                if accel == "mega-jnp"
+                else functools.partial(
+                    pk.mega_solve_pallas,
+                    interpret=accel == "mega-interpret",
+                )
+            )
+            asg_f, gpu_free, mem_free, r_f, _ = fill_fn(
+                S, jobs.gpu_demand, jobs.mem_demand, accept_key,
+                rankf_fill, jobs.current_node, fillable, gf_fill,
+                mem_free, v_g, v_m,
+                max_rounds=pk.mega_window(N, J) + 1, q_lo=q_lo,
+                q_scale=q_scale, q_max=q_max,
+                node_idx_bits=node_idx_bits,
+            )
+            assigned = jnp.where(
+                fillable & (asg_f >= 0), asg_f, assigned
+            )
+            rounds = rounds + r_f
+        else:
+            assigned, gpu_free, mem_free, rounds, _ = run_rounds(
+                assigned, gf_fill, mem_free, rounds, rankf_fill,
+                rounds + jnp.sum(fillable.astype(jnp.int32)) + 1,
+            )
         return assigned, gpu_free, mem_free, rounds
 
     incomplete_gang = jnp.any(

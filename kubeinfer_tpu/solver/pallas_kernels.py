@@ -611,17 +611,18 @@ def fence_minrank_pallas(
 # nothing next to the old standalone fence kernel + launch. Cross-window
 # inversion is prevented by the serialization itself (earlier windows hold
 # all strictly-higher priority ranks when the job axis is sorted). The
-# separate fence kernel, its launch, and the activity vectors disappear;
-# the home-bid fence exemption is dropped deliberately. The result is NOT
+# separate fence kernel, its launch, and the activity vectors disappear.
+# The home-bid fence exemption is KEPT (an incumbent may always bid its own
+# node): a fence-free-for-incumbents round is what holds survivor moves at
+# ~0.2% under churn — dropping it was tried and measured at 6.1% moves on
+# the 10k bench shape — at the price of the same documented inversion the
+# pipelined path accepts (see _mega_round_math). The result is NOT
 # bit-identical to the pipelined algorithm (later windows see
 # post-settlement capacities instead of bidding early on unfenced nodes —
-# if anything a closer match to serial FFD, and dropping the exemption
-# removes the one priority inversion the old path allowed: a low-priority
-# incumbent's early home-grab deflecting a high-priority bidder). It keeps
-# the same hard guarantees: no overcommit ever, at exit no unplaced job
-# finds any node feasible (capacities only shrink, so earlier windows'
-# fixpoints survive later consumption), and no job is fenced out by an
-# equal-or-lower rank.
+# if anything a closer match to serial FFD). It keeps the same hard
+# guarantees: no overcommit ever, at exit no unplaced job finds any node
+# feasible (capacities only shrink, so earlier windows' fixpoints survive
+# later consumption), and no job is fenced out by an equal-or-lower rank.
 #
 # Parity contract: the kernel body and the pure-jnp twin (mega_rounds_jnp)
 # share _mega_round_math, so interpret-mode output is bit-identical to the
@@ -673,6 +674,7 @@ def _mega_round_math(
     key,  # [1, W] i32 accept key (rank | demand desc | index)
     rank,  # [1, W] f32 fence rank (class-compressed crank; RANK_INF for
     #        invalid jobs)
+    cur,  # [1, W] i32 incumbent node index (-1 = none)
     may,  # [1, W] bool job may ever bid (valid)
     asg,  # [1, W] i32 assigned node, -1 = unplaced
     gf,  # [N, 1] gpu free (invalid nodes folded to -1)
@@ -716,11 +718,20 @@ def _mega_round_math(
         ),
         lambda: jnp.full((feas.shape[0], 1), rank_inf, jnp.float32),
     )
-    feas = feas & (rank_eff <= minrank)
+    n_glob = jax.lax.broadcasted_iota(jnp.int32, feas.shape, 0)
+    # Home-bid fence exemption (same trade the pipelined path makes,
+    # core._round_bids_jnp): an incumbent may always bid its OWN node —
+    # rank-ordered acceptance there still lets a same-node higher-rank
+    # bidder win, but without the exemption every fenced round strands
+    # incumbents whose nodes interest a higher class, and survivor moves
+    # under 10% churn measured 6.1% (BENCH r4 pre-fix) vs the ~0.2%
+    # stability contract (BASELINE config 4). The cost is the one known
+    # inversion: an incumbent's early home-grab can deflect a
+    # higher-rank job that only discovers the node a round later.
+    feas = feas & ((rank_eff <= minrank) | (cur == n_glob))
     # live best-fit pressure, pre-scaled into quantized units ([N, 1])
     uq = (vg * gf + vm * mf) * q_scale
     q = jnp.clip(Sq + uq, 0.0, q_max)
-    n_glob = jax.lax.broadcasted_iota(jnp.int32, feas.shape, 0)
     packed = jnp.where(feas, (q.astype(jnp.int32) << node_idx_bits) | n_glob, big)
     prim = jnp.min(packed, axis=0, keepdims=True)  # [1, W]
     node_mask = jnp.int32((1 << node_idx_bits) - 1)
@@ -762,6 +773,7 @@ def _mega_kernel(
     md_ref,  # [1, W] f32 mem demand
     key_ref,  # [1, W] i32 accept key
     rank_ref,  # [1, W] f32 fence rank (RANK_INF for invalid)
+    cur_ref,  # [1, W] i32 incumbent node index (-1 = none)
     may_ref,  # [1, W] i32 job validity (1 = may bid)
     gf0_ref,  # [N, 1] f32 starting gpu free (invalid nodes folded to -1)
     mf0_ref,  # [N, 1] f32 starting mem free
@@ -794,6 +806,7 @@ def _mega_kernel(
     md = md_ref[:]
     key = key_ref[:]
     rank = rank_ref[:]
+    cur = cur_ref[:]
     may = may_ref[:] != 0
     Sq = (s_ref[:] - q_lo) * q_scale  # once per window, not per round
     vg = vg_ref[:]
@@ -806,7 +819,7 @@ def _mega_kernel(
     def body(carry):
         asg, gf, mf, r, _ = carry
         asg, gf, mf, prog = _mega_round_math(
-            Sq, d, md, key, rank, may, asg, gf, mf, vg, vm,
+            Sq, d, md, key, rank, cur, may, asg, gf, mf, vg, vm,
             q_scale=q_scale, q_max=q_max,
             node_idx_bits=node_idx_bits,
         )
@@ -837,6 +850,7 @@ def mega_solve_pallas(
     md: jax.Array,  # f32[J]
     accept_key: jax.Array,  # i32[J]
     rankf: jax.Array,  # f32[J] fence rank (RANK_INF for invalid)
+    current_node: jax.Array,  # i32[J] incumbent node (-1 = none)
     may_bid: jax.Array,  # bool[J] (valid jobs)
     gf_eff: jax.Array,  # f32[N] (invalid nodes folded to -1)
     mf: jax.Array,  # f32[N]
@@ -889,6 +903,7 @@ def mega_solve_pallas(
             row,  # md
             row,  # key
             row,  # rank
+            row,  # cur
             row,  # may
             const_col,  # gf0
             const_col,  # mf0
@@ -919,6 +934,7 @@ def mega_solve_pallas(
         md.reshape(1, J),
         accept_key.reshape(1, J),
         rankf.reshape(1, J),
+        current_node.reshape(1, J),
         may_bid.astype(jnp.int32).reshape(1, J),
         gf_eff.reshape(N, 1),
         mf.reshape(N, 1),
@@ -935,6 +951,7 @@ def mega_rounds_jnp(
     md: jax.Array,
     accept_key: jax.Array,
     rankf: jax.Array,
+    current_node: jax.Array,
     may_bid: jax.Array,
     gf_eff: jax.Array,
     mf: jax.Array,
@@ -959,6 +976,7 @@ def mega_rounds_jnp(
     md2 = md.reshape(1, J)
     key2 = accept_key.reshape(1, J)
     rank2 = rankf.reshape(1, J)
+    cur2 = current_node.reshape(1, J)
     may2 = may_bid.reshape(1, J)
     gf0 = gf_eff.reshape(N, 1)
     mf0 = mf.reshape(N, 1)
@@ -975,6 +993,7 @@ def mega_rounds_jnp(
         mdw = jax.lax.dynamic_slice(md2, (0, col), (1, W))
         keyw = jax.lax.dynamic_slice(key2, (0, col), (1, W))
         rankw = jax.lax.dynamic_slice(rank2, (0, col), (1, W))
+        curw = jax.lax.dynamic_slice(cur2, (0, col), (1, W))
         mayw = jax.lax.dynamic_slice(may2, (0, col), (1, W))
 
         def cond(carry):
@@ -984,7 +1003,8 @@ def mega_rounds_jnp(
         def body(carry):
             asg, gf, mf_c, r, _ = carry
             asg, gf, mf_c, prog = _mega_round_math(
-                Sw, dw, mdw, keyw, rankw, mayw, asg, gf, mf_c, vg2, vm2,
+                Sw, dw, mdw, keyw, rankw, curw, mayw, asg, gf, mf_c,
+                vg2, vm2,
                 q_scale=q_scale, q_max=q_max,
                 node_idx_bits=node_idx_bits,
             )

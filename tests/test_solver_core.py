@@ -670,12 +670,10 @@ class TestMegaSerializedGreedy:
             assert nodes_out[2] == -1, (accel, nodes_out)
 
     def test_strict_class_priority_order(self):
-        """Serialization makes priority semantics STRONGER than the
-        pipelined fence: the top class settles before lower classes bid
-        at all, so a top-priority job always gets first pick of a
-        contested node — including the inversion the pipelined path
-        allows through its home-bid exemption (a low-priority incumbent
-        grabbing home before the top class discovers the node)."""
+        """Cross-window serialization gives the top class first pick of
+        a contested node. The home-bid exemption does not invert THIS
+        case: when the top-priority job bids the incumbent's node in the
+        same round, rank-ordered acceptance still hands it the node."""
         from kubeinfer_tpu.solver.problem import encode_problem_arrays
 
         # One node with 8 chips. Top-priority newcomer needs all 8; a
@@ -694,13 +692,12 @@ class TestMegaSerializedGreedy:
         assert int(a.node[1]) == -1
 
     def test_churn_stability(self):
-        """Move hysteresis alone (mega has no home-bid fence exemption)
-        must keep surviving incumbents mostly in place under 10% churn.
-        The bound is looser than the pipelined path's (~0.2%): mega lets
-        a strictly-higher-priority arrival fence an incumbent off its
-        home for a round — a priority-correct preemption the pipelined
-        exemption suppressed (along with the inversion it allowed);
-        measured ~2% at this shape."""
+        """Surviving incumbents stay put under 10% churn. Mega carries
+        the same home-bid fence exemption as the pipelined path —
+        without it, incumbents whose node interests a higher class get
+        fenced off their own home every round and survivor moves
+        measured 6.1% at the 10k bench shape (BENCH r4 pre-fix) against
+        the ~0.2% stability contract (BASELINE config 4)."""
         from kubeinfer_tpu.solver.problem import encode_problem_arrays
 
         rng = np.random.default_rng(11)
@@ -726,7 +723,7 @@ class TestMegaSerializedGreedy:
         new = np.asarray(second.node)[:J]
         survivors = ~departed
         moved = (new[survivors] != current[survivors]).mean()
-        assert moved < 0.05, f"{moved:.1%} of surviving incumbents moved"
+        assert moved < 0.02, f"{moved:.1%} of surviving incumbents moved"
         assert (new >= 0).all()
 
 

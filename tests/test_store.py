@@ -196,3 +196,102 @@ class TestReviewRegressions:
         assert e2.object["spec"]["holder"] == "p0"
         w1.close()
         w2.close()
+
+
+class TestDurability:
+    """Journal + snapshot durability (r3 verdict item 3): objects AND the
+    resourceVersion counter survive restart; CAS continuity holds; torn
+    journal tails and snapshot compaction are crash-safe."""
+
+    def test_state_and_rv_survive_reopen(self, tmp_path):
+        s = Store(data_dir=tmp_path)
+        a = s.create("LLMService", obj("svc-a", spec={"replicas": 2}))
+        s.create("Lease", obj("l0", spec={"holder": "p0"}))
+        a2 = s.get("LLMService", "svc-a")
+        a2["spec"]["replicas"] = 3
+        s.update("LLMService", a2)
+        s.create("Node", obj("n0"))
+        s.delete("Node", "n0")
+        s.close()
+
+        r = Store(data_dir=tmp_path)
+        got = r.get("LLMService", "svc-a")
+        assert got["spec"]["replicas"] == 3
+        assert r.get("Lease", "l0")["spec"]["holder"] == "p0"
+        with pytest.raises(NotFoundError):
+            r.get("Node", "n0")
+        # CAS continuity: an rv read BEFORE the restart must still CAS
+        # correctly after it — and a stale one must still conflict
+        # (lease stealing depends on this, election.go:133-134).
+        stale = dict(a)
+        stale["metadata"] = dict(a["metadata"])  # rv from before update
+        stale["spec"] = {"replicas": 9}
+        with pytest.raises(ConflictError):
+            r.update("LLMService", stale)
+        cur = r.get("LLMService", "svc-a")
+        cur["spec"]["replicas"] = 4
+        upd = r.update("LLMService", cur)
+        assert upd["metadata"]["resourceVersion"] > got["metadata"][
+            "resourceVersion"
+        ]
+
+    def test_torn_journal_tail_tolerated(self, tmp_path):
+        s = Store(data_dir=tmp_path)
+        s.create("Lease", obj("a", spec={"holder": "p0"}))
+        s.create("Lease", obj("b", spec={"holder": "p1"}))
+        s.close()
+        with open(tmp_path / "journal.jsonl", "a", encoding="utf-8") as f:
+            f.write('{"op":"create","kind":"Lease","ns":"default","na')
+        r = Store(data_dir=tmp_path)
+        assert {o["metadata"]["name"] for o in r.list("Lease")} == {"a", "b"}
+        # the reopened store can still append past the torn tail
+        r.create("Lease", obj("c"))
+        r.close()
+        r2 = Store(data_dir=tmp_path)
+        assert len(r2.list("Lease")) == 3
+
+    def test_snapshot_compaction_and_replay(self, tmp_path, monkeypatch):
+        import kubeinfer_tpu.controlplane.store as store_mod
+
+        monkeypatch.setattr(store_mod, "SNAPSHOT_EVERY", 10)
+        s = Store(data_dir=tmp_path)
+        for i in range(23):
+            s.create("Node", obj(f"n{i}", spec={"i": i}))
+        s.close()
+        assert (tmp_path / "snapshot.json").exists()
+        # journal was rotated at the last compaction: only the tail
+        # records since then remain
+        lines = (tmp_path / "journal.jsonl").read_text().strip().splitlines()
+        assert len(lines) < 10
+        r = Store(data_dir=tmp_path)
+        assert len(r.list("Node")) == 23
+        assert r.get("Node", "n22")["spec"]["i"] == 22
+
+    def test_duplicate_pre_snapshot_records_skipped(self, tmp_path, monkeypatch):
+        """Crash between snapshot rename and journal rotation leaves the
+        full journal behind; replay must skip records <= snapshot rv."""
+        import json as _json
+
+        import kubeinfer_tpu.controlplane.store as store_mod
+
+        s = Store(data_dir=tmp_path)
+        s.create("Node", obj("n0", spec={"i": 0}))
+        cur = s.get("Node", "n0")
+        cur["spec"]["i"] = 1
+        s.update("Node", cur)
+        # simulate the crash window: snapshot written, journal NOT rotated
+        snap = {
+            "rv": 2,
+            "objects": [["Node", "default", "n0", s.get("Node", "n0")]],
+        }
+        (tmp_path / "snapshot.json").write_text(_json.dumps(snap))
+        s.close()
+        r = Store(data_dir=tmp_path)
+        assert r.get("Node", "n0")["spec"]["i"] == 1
+        assert len(r.list("Node")) == 1
+
+    def test_in_memory_store_untouched(self, tmp_path):
+        s = Store()
+        s.create("Node", obj("n0"))
+        assert not any(tmp_path.iterdir())
+        s.close()  # no-op
