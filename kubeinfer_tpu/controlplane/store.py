@@ -152,6 +152,17 @@ class Store:
             with open(jpath, "r+b") as f:
                 f.truncate(good_end)
 
+    def _check_open(self) -> None:
+        """Reject mutations on a closed durable store BEFORE any state
+        changes: raising from _append after the in-memory write would
+        leave memory diverged from the journal (object applied, rv
+        consumed, nothing durable) — the review repro for this."""
+        if self._durable and self._journal_f is None:
+            raise RuntimeError(
+                "durable store is closed; mutations would be lost on "
+                "restart"
+            )
+
     def _append(
         self, op: str, key: Key, rv: int, obj: dict[str, Any] | None
     ) -> None:
@@ -165,12 +176,7 @@ class Store:
         window is the mutations whose fsync hadn't completed — each
         mutator only returns to ITS caller after its own fsync."""
         if self._journal_f is None:
-            if self._durable:
-                raise RuntimeError(
-                    "durable store is closed; mutations would be lost "
-                    "on restart"
-                )
-            return
+            return  # in-memory store (closed-durable rejected up front)
         rec: dict[str, Any] = {
             "op": op, "kind": key.kind, "ns": key.namespace,
             "name": key.name, "rv": rv,
@@ -271,6 +277,7 @@ class Store:
             raise ValueError("metadata.name is required")
         key = Key(kind, namespace, name)
         with self._lock:
+            self._check_open()
             if key in self._objects:
                 raise AlreadyExistsError(f"{kind} {namespace}/{name} already exists")
             rv = self._next_rv()
@@ -300,6 +307,7 @@ class Store:
         namespace = meta.get("namespace", "default")
         key = Key(kind, namespace, name)
         with self._lock:
+            self._check_open()
             current = self._objects.get(key)
             if current is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
@@ -322,6 +330,7 @@ class Store:
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         key = Key(kind, namespace, name)
         with self._lock:
+            self._check_open()
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")

@@ -40,10 +40,18 @@ Fully-masked rows reproduce the dense path's uniform-softmax output
 exactly (all scores -1e30 -> p == 1 everywhere -> o/l is the mean over
 S), so parity holds even on padding rows.
 
-FORWARD-ONLY: no custom_vjp is defined, so none of these kernels can
-sit under jax.grad — differentiated callers (train.causal_lm_loss) pin
-``attn_fn=attention``. A flash backward (recompute-based, like the
-public flash-attention backward) is future work.
+Backward (r3 verdict item 6): ``flash_attention_causal_diff`` wraps the
+ragged kernel in a custom_vjp with the recompute-based backward from the
+public flash-attention literature — the forward additionally emits the
+per-row logsumexp L = m + log(l), and the backward re-materializes each
+[tile_t*G, tile_s] probability block in VMEM from (q, k, L) instead of
+ever having stored it: dv += p^T dO, ds = p * (dO v^T − rowsum(dO*O)),
+dq += ds k, dk += ds^T q. Two kernels mirror the forward's
+accumulate-across-inner-grid idiom: dq sweeps S with a resident [TqG, D]
+accumulator; dk/dv sweep T with resident [Sk, D] accumulators (the GQA
+row fold makes the G-group reduction implicit in the ds^T q contraction).
+``causal_attention_auto`` routes through the differentiable wrapper, so
+training no longer pins the dense path (train.causal_lm_loss).
 
 No reference counterpart: the reference delegates all attention to the
 external vLLM process (SURVEY.md §2, vllm.go:93-112).
@@ -302,6 +310,346 @@ def flash_attention_ragged(
     )
 
 
+# --- backward (recompute-based custom_vjp over the ragged kernel) ----------
+
+
+def _ragged_pen(c0, row_len, tq, ts, tile_t, tile_s):
+    """The ragged causal penalty tile, shared by the lse-forward and both
+    backward kernels — identical mask derivation is what makes the
+    recomputed probabilities match the forward bit-for-bit."""
+    q_pos = (
+        c0 + tq * tile_t
+        + jax.lax.broadcasted_iota(jnp.int32, (tile_t, tile_s), 0)
+    )
+    s_pos = ts * tile_s + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_t, tile_s), 1
+    )
+    attend = (s_pos <= q_pos) & (s_pos < row_len)
+    return jnp.where(attend, 0.0, -1e30)
+
+
+def _flash_ragged_lse_kernel(
+    c0_ref, len_ref,
+    q_ref, k_ref, v_ref,
+    o_ref,
+    lse_ref,  # [1, TILE_T * G, 1] out: per-row logsumexp (m + log l)
+    m_scr, l_scr, acc_scr,
+    *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+):
+    """The ragged forward, additionally emitting the logsumexp the
+    backward's probability recompute needs. Identical o math to
+    _flash_ragged_kernel (same _softmax_fold) — custom_vjp requires the
+    fwd path to reproduce the primal's output exactly."""
+    tq = pl.program_id(1)
+    ts = pl.program_id(2)
+    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    _softmax_fold(
+        q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
+        groups=groups, scale=scale, s_tiles=s_tiles,
+    )
+
+    @pl.when(ts == s_tiles - 1)
+    def _emit_lse():
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
+
+
+def _recompute_p(q, k, pen, lse_col, groups, scale):
+    """[TqG, Sk] softmax probabilities from (q, k, L): exp(qk*scale +
+    pen - L). Exact — L is the forward's converged logsumexp."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    tq, sk = pen.shape
+    s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
+        tq * groups, sk
+    )
+    return jnp.exp(s - lse_col)
+
+
+def _flash_bwd_dq_kernel(
+    c0_ref, len_ref,
+    q_ref, k_ref, v_ref, do_ref,  # [1, TqG, D] / [1, Sk, D] blocks
+    lse_ref,  # [1, TqG]
+    drow_ref,  # [1, TqG] rowsum(dO * O)
+    dq_ref,  # [1, TqG, D] out
+    dq_scr,  # f32[TqG, D] scratch
+    *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+):
+    tq = pl.program_id(1)
+    ts = pl.program_id(2)  # innermost: S sweep, dq resident
+
+    @pl.when(ts == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    p = _recompute_p(
+        q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
+    )
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TqG, Sk]
+    ds = p * (dp - drow_ref[0])
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ts == s_tiles - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    c0_ref, len_ref,
+    q_ref, k_ref, v_ref, do_ref,
+    lse_ref, drow_ref,
+    dk_ref, dv_ref,  # [1, Sk, D] out
+    dk_scr, dv_scr,  # f32[Sk, D] scratch
+    *, groups: int, scale: float, t_tiles: int, tile_t: int, tile_s: int,
+):
+    ts = pl.program_id(1)
+    tq = pl.program_id(2)  # innermost: T sweep, dk/dv resident
+
+    @pl.when(tq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    p = _recompute_p(
+        q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
+    )
+    # dv += p^T dO; the folded (t, g) rows make the GQA group reduction
+    # implicit in the row contraction
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - drow_ref[0])
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(tq == t_tiles - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _check_diff_tiles(T, S, tile_t, tile_s):
+    if T % tile_t or S % tile_s:
+        raise ValueError(
+            f"flash_attention_causal_diff needs T divisible by {tile_t} "
+            f"and S by {tile_s}; got T={T} S={S} (use the dense path for "
+            "unaligned shapes)"
+        )
+
+
+def _fold_qlike(x, n_kv):
+    """[B, T, n_heads, D] -> [B*n_kv, T*G, D] (the kernels' row fold)."""
+    B, T, n_heads, D = x.shape
+    G = n_heads // n_kv
+    return (
+        x.reshape(B, T, n_kv, G, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B * n_kv, T * G, D)
+    )
+
+
+def _unfold_qlike(x, B, n_kv, T, G, D):
+    return (
+        x.reshape(B, n_kv, T, G, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, T, n_kv * G, D)
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def flash_attention_causal_diff(interpret, q, k, v, q_offset, row_lens):
+    """Differentiable ragged-causal flash attention.
+
+    Primal = flash_attention_ragged (bit-identical); under jax.grad the
+    fwd re-runs with the lse output and the bwd runs the recompute
+    kernels. ``interpret`` is a nondiff static for CPU parity tests.
+    """
+    # tile sizes resolved at CALL time from the module globals — the
+    # vjp fwd below reads the same globals, so primal and fwd always
+    # tile (and therefore accumulate) identically, even under tests
+    # that monkeypatch TILE_T/TILE_S
+    return flash_attention_ragged(
+        q, k, v, q_offset, row_lens,
+        tile_t=TILE_T, tile_s=TILE_S, interpret=interpret,
+    )
+
+
+def _diff_fwd(interpret, q, k, v, q_offset, row_lens):
+    B, T, n_heads, D = q.shape
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_t = min(TILE_T, T)
+    tile_s = min(TILE_S, S)
+    _check_diff_tiles(T, S, tile_t, tile_s)
+    t_tiles, s_tiles = T // tile_t, S // tile_s
+    qf = _fold_qlike(q, n_kv)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    c0 = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    lens = jnp.asarray(row_lens, jnp.int32)
+    kern = functools.partial(
+        _flash_ragged_lse_kernel, groups=G, scale=1.0 / float(D) ** 0.5,
+        s_tiles=s_tiles, tile_t=tile_t, tile_s=tile_s,
+    )
+    smem1 = pl.BlockSpec(
+        (1,), lambda bh, tq, ts: (0,), memory_space=pltpu.SMEM
+    )
+    smem_b = pl.BlockSpec(
+        (1,), lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv,),
+        memory_space=pltpu.SMEM,
+    )
+    qspec = pl.BlockSpec(
+        (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kspec = pl.BlockSpec(
+        (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(B * n_kv, t_tiles, s_tiles),
+        in_specs=[smem1, smem_b, qspec, kspec, kspec],
+        out_specs=[
+            qspec,
+            pl.BlockSpec(
+                (1, tile_t * G, 1), lambda bh, tq, ts: (bh, tq, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
+            jax.ShapeDtypeStruct((B * n_kv, T * G, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, 1), jnp.float32),
+            pltpu.VMEM((tile_t * G, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(c0, lens, qf, kf, vf)
+    o = _unfold_qlike(out, B, n_kv, T, G, D)
+    return o, (q, k, v, c0, lens, out, lse)
+
+
+def _diff_bwd(interpret, res, do):
+    q, k, v, c0, lens, of, lse = res
+    B, T, n_heads, D = q.shape
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_t = min(TILE_T, T)
+    tile_s = min(TILE_S, S)
+    t_tiles, s_tiles = T // tile_t, S // tile_s
+    scale = 1.0 / float(D) ** 0.5
+    qf = _fold_qlike(q, n_kv)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    dof = _fold_qlike(do, n_kv)
+    # rowsum(dO * O): cheap fused XLA reduce, shared by both kernels
+    drow = jnp.sum(
+        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=2,
+        keepdims=True,
+    )  # [B*n_kv, T*G, 1]
+
+    smem1 = pl.BlockSpec(
+        (1,), lambda bh, a, b: (0,), memory_space=pltpu.SMEM
+    )
+    smem_b = pl.BlockSpec(
+        (1,), lambda bh, a, b, n_kv=n_kv: (bh // n_kv,),
+        memory_space=pltpu.SMEM,
+    )
+
+    # dq: grid (bh, tq, ts), S innermost
+    q_at_tq = pl.BlockSpec(
+        (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_at_ts = pl.BlockSpec(
+        (1, tile_s, D), lambda bh, tq, ts: (bh, ts, 0),
+        memory_space=pltpu.VMEM,
+    )
+    row_at_tq = pl.BlockSpec(
+        (1, tile_t * G, 1), lambda bh, tq, ts: (bh, tq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dqf = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, groups=G, scale=scale, s_tiles=s_tiles,
+            tile_t=tile_t, tile_s=tile_s,
+        ),
+        grid=(B * n_kv, t_tiles, s_tiles),
+        in_specs=[smem1, smem_b, q_at_tq, kv_at_ts, kv_at_ts, q_at_tq,
+                  row_at_tq, row_at_tq],
+        out_specs=q_at_tq,
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((tile_t * G, D), jnp.float32)],
+        interpret=interpret,
+    )(c0, lens, qf, kf, vf, dof, lse, drow)
+
+    # dk/dv: grid (bh, ts, tq), T innermost
+    q_at_tq2 = pl.BlockSpec(
+        (1, tile_t * G, D), lambda bh, ts, tq: (bh, tq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_at_ts2 = pl.BlockSpec(
+        (1, tile_s, D), lambda bh, ts, tq: (bh, ts, 0),
+        memory_space=pltpu.VMEM,
+    )
+    row_at_tq2 = pl.BlockSpec(
+        (1, tile_t * G, 1), lambda bh, ts, tq: (bh, tq, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dkf, dvf = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, groups=G, scale=scale, t_tiles=t_tiles,
+            tile_t=tile_t, tile_s=tile_s,
+        ),
+        grid=(B * n_kv, s_tiles, t_tiles),
+        in_specs=[smem1, smem_b, q_at_tq2, kv_at_ts2, kv_at_ts2,
+                  q_at_tq2, row_at_tq2, row_at_tq2],
+        out_specs=[kv_at_ts2, kv_at_ts2],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * n_kv, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * n_kv, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_s, D), jnp.float32),
+            pltpu.VMEM((tile_s, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(c0, lens, qf, kf, vf, dof, lse, drow)
+
+    dq = _unfold_qlike(dqf, B, n_kv, T, G, D)
+    dk = dkf.reshape(B, n_kv, S, D).transpose(0, 2, 1, 3)
+    dv = dvf.reshape(B, n_kv, S, D).transpose(0, 2, 1, 3)
+    import numpy as _np
+
+    f0 = jax.dtypes.float0
+    return (
+        dq, dk, dv,
+        _np.zeros(jnp.shape(jnp.asarray(0, jnp.int32)), f0),
+        _np.zeros(res[4].shape, f0),
+    )
+
+
+flash_attention_causal_diff.defvjp(_diff_fwd, _diff_bwd)
+
+
 def flash_available(T: int, S: int, D: int) -> bool:
     """Shapes the kernels handle on the current default backend.
 
@@ -339,11 +687,13 @@ def causal_attention_auto(q, k, v, mask):
     kernel. ``mask`` is the caller's dense-fallback mask: the flash
     branch never reads it and XLA dead-code-eliminates its
     construction (the same contract as engine.chunked_prefill's flash
-    branch)."""
+    branch). Differentiable: the flash branch routes through the
+    custom_vjp wrapper, so this binding works under jax.grad (training
+    at long context no longer needs the dense path's [T, T] scores)."""
     B, T = q.shape[0], q.shape[1]
     S, D = k.shape[1], q.shape[3]
     if T == S and flash_available(T, S, D):
-        return flash_attention_ragged(
-            q, k, v, 0, jnp.full((B,), S, jnp.int32)
+        return flash_attention_causal_diff(
+            False, q, k, v, 0, jnp.full((B,), S, jnp.int32)
         )
     return dense_attention(q, k, v, mask)

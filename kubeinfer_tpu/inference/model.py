@@ -185,19 +185,34 @@ def decoder_layer(
     kv_cache: tuple[jax.Array, jax.Array] | None = None,
     cache_offset: jax.Array | int = 0,
     attn_fn=attention,
+    tp_axis: str | None = None,
+    tp_size: int = 1,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
-    """One pre-norm block; returns (x, updated kv cache or None)."""
+    """One pre-norm block; returns (x, updated kv cache or None).
+
+    ``tp_axis``/``tp_size`` run the block in MANUAL tensor parallelism
+    (inside a shard_map with Megatron-sharded weights,
+    sharding.param_specs): projections arrive column-sharded so this
+    device computes heads/tp_size attention heads and F/tp_size mlp
+    lanes, and the two row-parallel contractions (o_proj, down_proj /
+    moe down) psum over ``tp_axis``. The GSPMD path (jit + sharded
+    params) needs none of this — the compiler inserts the same psums —
+    but shard_map bodies (the sequence-parallel ring) see local shards
+    and must say the collectives out loud.
+    """
     B, T, H = x.shape
     D = cfg.head_dim
+    n_q = cfg.num_attention_heads // tp_size
+    n_kv = cfg.num_key_value_heads // tp_size
     h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
     q, k, v = h @ layer["q_proj"], h @ layer["k_proj"], h @ layer["v_proj"]
     if cfg.qkv_bias:  # Qwen2 family; o_proj stays bias-free
         q = q + layer["q_bias"]
         k = k + layer["k_bias"]
         v = v + layer["v_bias"]
-    q = q.reshape(B, T, cfg.num_attention_heads, D)
-    k = k.reshape(B, T, cfg.num_key_value_heads, D)
-    v = v.reshape(B, T, cfg.num_key_value_heads, D)
+    q = q.reshape(B, T, n_q, D)
+    k = k.reshape(B, T, n_kv, D)
+    v = v.reshape(B, T, n_kv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -222,16 +237,31 @@ def decoder_layer(
         kv_cache = (ck, cv)
 
     attn = attn_fn(q, k, v, mask)
-    x = x + attn.reshape(B, T, H) @ layer["o_proj"]
+    attn_out = attn.reshape(B, T, n_q * D) @ layer["o_proj"]
+    if tp_axis is not None:
+        # row-parallel epilogue: each device contracted its own heads
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
     if "moe" in layer:  # Mixtral family (static: pytree structure)
         from kubeinfer_tpu.inference.moe import moe_block
 
-        x = x + moe_block(layer["moe"], h, top_k=cfg.num_experts_per_tok)
+        m = moe_block(layer["moe"], h, top_k=cfg.num_experts_per_tok)
+        if tp_axis is not None:
+            # experts shard like the dense mlp (param_specs): each
+            # device holds every expert's F/tp lanes; the router sees
+            # replicated h, so gating is identical across devices and
+            # one psum after the expert-weighted sum completes the
+            # row-parallel down contraction
+            m = jax.lax.psum(m, tp_axis)
+        x = x + m
     else:
         gate = jax.nn.silu(h @ layer["gate_proj"])
-        x = x + (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
+        mlp = (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
+        x = x + mlp
     return x, kv_cache
 
 
@@ -251,8 +281,17 @@ def forward(
     kv_caches: list[tuple[jax.Array, jax.Array]] | None = None,
     cache_offset: jax.Array | int = 0,
     attn_fn=None,
+    tp_axis: str | None = None,
+    tp_size: int = 1,
 ) -> tuple[jax.Array, list | None]:
     """Logits [B, T, V] (+ updated KV caches when provided).
+
+    ``tp_axis``/``tp_size``: manual tensor parallelism for shard_map
+    bodies (see decoder_layer). The returned logits are then
+    vocab-sharded [B, T, V/tp] when the model has a separate ``lm_head``
+    (column-parallel per sharding.param_specs) and full-width when
+    embeddings are tied (embed_tokens is replicated) — the caller's
+    out_specs must match.
 
     ``attn_fn=None`` (the default) means auto: the plain causal no-cache
     path derives its mask in-kernel on TPU (causal_attention_auto);
@@ -300,6 +339,7 @@ def forward(
         x, cache = decoder_layer(
             layer, x, cos, sin, attn_mask, cfg,
             kv_cache=cache, cache_offset=cache_offset, attn_fn=attn_fn,
+            tp_axis=tp_axis, tp_size=tp_size,
         )
         if new_caches is not None:
             new_caches.append(cache)

@@ -62,12 +62,18 @@ def ring_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    extra_vary: tuple[str, ...] = (),
 ) -> jax.Array:
     """Exact causal attention with K/V rotating around ``axis_name``.
 
     Must run inside shard_map with the sequence axis sharded over
     ``axis_name``. Returns the local attention output block
-    [B, T_loc, n_heads, D].
+    [B, T_loc, n_heads, D]. ``extra_vary`` names additional manual mesh
+    axes the INPUT blocks vary over (e.g. ``("tp",)`` when the head axis
+    is tensor-parallel-sharded) — the scan's accumulator carries must be
+    declared varying over exactly the same axes as the per-step values
+    merged into them, or shard_map's manual-axes type check rejects the
+    carry.
     """
     P = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
@@ -84,7 +90,7 @@ def ring_attention(
     # shard_map's manual-axes type check requires the carry declared
     # varying up front.
     def vary(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, (axis_name,) + extra_vary, to="varying")
 
     m = vary(jnp.full((B, n_kv, G, T_loc), -jnp.inf, jnp.float32))
     l = vary(jnp.zeros((B, n_kv, G, T_loc), jnp.float32))

@@ -21,28 +21,36 @@ from kubeinfer_tpu.inference.model import Params, attention, forward
 
 
 def causal_lm_loss(
-    params: Params, tokens: jax.Array, cfg: ModelConfig
+    params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None
 ) -> jax.Array:
     """Mean next-token cross entropy over [B, T] (targets = shift-left).
 
-    ``attn_fn`` pinned to the dense einsum path: this loss sits under
-    ``jax.value_and_grad``, and the default forward's causal flash
-    kernel (a Pallas call, forward-only — no custom_vjp) would fail to
-    differentiate at trace time on TPU-aligned shapes (advisor r3).
+    Uses the DEFAULT forward attention binding (causal_attention_auto):
+    on TPU-aligned shapes that is the flash kernel pair, now
+    differentiable through its recompute-based custom_vjp
+    (flash_attention.py), so a long-context train step never
+    materializes the [T, T] score tensor the r3 pin forced. The GSPMD-
+    sharded path (sharded_train_step) still pins the dense einsum —
+    Pallas calls cannot partition under GSPMD.
     """
-    logits, _ = forward(params, tokens[:, :-1], cfg, attn_fn=attention)
+    logits, _ = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "attn_fn"), donate_argnums=(0,)
+)
 def train_step(
-    params: Params, tokens: jax.Array, cfg: ModelConfig, lr: float = 1e-3
+    params: Params, tokens: jax.Array, cfg: ModelConfig, lr: float = 1e-3,
+    attn_fn=None,
 ) -> tuple[Params, jax.Array]:
     """One SGD step; params are donated (updated in place on device)."""
-    loss, grads = jax.value_and_grad(causal_lm_loss)(params, tokens, cfg)
+    loss, grads = jax.value_and_grad(causal_lm_loss)(
+        params, tokens, cfg, attn_fn
+    )
     new_params = jax.tree.map(
         lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype),
         params, grads,
@@ -64,7 +72,10 @@ def sharded_train_step(mesh: Mesh, cfg: ModelConfig):
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(params: Params, tokens: jax.Array):
-        new_params, loss = train_step(params, tokens, cfg)
+        # dense attention pinned: a Pallas custom call cannot partition
+        # under this GSPMD-sharded jit (the single-device train_step
+        # default is the differentiable flash path)
+        new_params, loss = train_step(params, tokens, cfg, attn_fn=attention)
         return new_params, jax.lax.with_sharding_constraint(
             loss, NamedSharding(mesh, P())
         )

@@ -273,3 +273,137 @@ class TestCausalAuto:
         np.testing.assert_array_equal(
             np.asarray(auto_logits), np.asarray(ref_logits)
         )
+
+
+class TestFlashBackward:
+    """The recompute-based custom_vjp (r3 verdict item 6): gradients of
+    the flash path must match the dense path's at tolerance, across
+    multi-tile grids, GQA grouping, ragged lengths, and bf16 inputs."""
+
+    def _grads(self, B, T, n_heads, n_kv, D, lens, dtype=jnp.float32,
+               tile_t=8, tile_s=16):
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        q, k, v = _rand(jax.random.PRNGKey(3), B, T, T, n_heads, n_kv, D,
+                        dtype)
+        row_lens = jnp.asarray(lens, jnp.int32)
+        w = jax.random.normal(
+            jax.random.PRNGKey(7), (B, T, n_heads, D), jnp.float32
+        )
+
+        t_pos = jnp.arange(T)
+        mask = (
+            (t_pos[None, :, None] >= t_pos[None, None, :])
+            & (t_pos[None, None, :] < row_lens[:, None, None])
+        )
+
+        def loss_dense(q, k, v):
+            o = dense_attention(q, k, v, mask)
+            return jnp.sum(o.astype(jnp.float32) * w)
+
+        mp = pytest.MonkeyPatch()
+        mp.setattr(fa, "TILE_T", tile_t)
+        mp.setattr(fa, "TILE_S", tile_s)
+        try:
+            def loss_flash(q, k, v):
+                o = fa.flash_attention_causal_diff(
+                    True, q, k, v, 0, row_lens
+                )
+                return jnp.sum(o.astype(jnp.float32) * w)
+
+            gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            mp.undo()
+        return gd, gf
+
+    def _assert_close(self, gd, gf, atol):
+        for want, got, name in zip(gd, gf, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                atol=atol, rtol=5e-3, err_msg=f"d{name}",
+            )
+
+    def test_grad_parity_multi_tile(self):
+        gd, gf = self._grads(2, 32, 4, 4, 16, [32, 20])
+        self._assert_close(gd, gf, 2e-4)
+
+    def test_grad_parity_gqa(self):
+        gd, gf = self._grads(1, 32, 4, 2, 16, [25])
+        self._assert_close(gd, gf, 2e-4)
+
+    def test_grad_parity_bf16(self):
+        gd, gf = self._grads(1, 32, 2, 2, 16, [32], dtype=jnp.bfloat16)
+        self._assert_close(gd, gf, 5e-2)
+
+    def test_primal_value_unchanged(self):
+        """The custom_vjp primal must equal the plain ragged kernel
+        bit-for-bit (custom_vjp contract: fwd reproduces the primal)."""
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        q, k, v = _rand(
+            jax.random.PRNGKey(1), 1, 16, 16, 2, 2, 16, jnp.float32
+        )
+        lens = jnp.asarray([16], jnp.int32)
+        a = fa.flash_attention_ragged(
+            q, k, v, 0, lens, tile_t=8, tile_s=16, interpret=True
+        )
+        mp = pytest.MonkeyPatch()
+        mp.setattr(fa, "TILE_T", 8)
+        mp.setattr(fa, "TILE_S", 16)
+        try:
+            b = fa.flash_attention_causal_diff(True, q, k, v, 0, lens)
+            # the fwd-with-lse variant's primal output (what callers see
+            # under differentiation) must also be bit-identical
+            c, _ = jax.vjp(
+                lambda q, k, v: fa.flash_attention_causal_diff(
+                    True, q, k, v, 0, lens
+                ),
+                q, k, v,
+            )
+        finally:
+            mp.undo()
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_train_loss_differentiates_with_flash(self):
+        """causal_lm_loss's default binding differentiates end to end
+        when the flash path engages (forced here via interpret-mode
+        attn_fn); loss and grads match the dense-pinned variant."""
+        from kubeinfer_tpu.inference import PRESETS, init_params
+        from kubeinfer_tpu.inference.train import causal_lm_loss
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(1, cfg.vocab_size, (1, 17)), jnp.int32
+        )
+
+        mp = pytest.MonkeyPatch()
+        mp.setattr(fa, "TILE_T", 8)
+        mp.setattr(fa, "TILE_S", 16)
+        try:
+            def flash_fn(q, k, v, mask):
+                B, S = q.shape[0], k.shape[1]
+                return fa.flash_attention_causal_diff(
+                    True, q, k, v, 0, jnp.full((B,), S, jnp.int32)
+                )
+
+            lf, gf = jax.value_and_grad(causal_lm_loss)(
+                params, tokens, cfg, flash_fn
+            )
+            ld, gd = jax.value_and_grad(causal_lm_loss)(
+                params, tokens, cfg, None
+            )
+        finally:
+            mp.undo()
+        np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+        flat_f = jax.tree.leaves(gf)
+        flat_d = jax.tree.leaves(gd)
+        for a, b in zip(flat_f, flat_d):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=3e-4, rtol=5e-3,
+            )

@@ -184,3 +184,74 @@ class TestRoutePrecedence:
         srv.complete({"prompt": _prompt(8), "max_tokens": 2})
         m = srv.registry.render().replace("'", '"')
         assert 'route="speculative",outcome="ok"' in m
+
+
+class TestSPTimesTP:
+    """SP x TP composition (r3 verdict item 5): the ring body runs with
+    Megatron-sharded weights — per-device weight bytes on the sp route
+    are full/tp, the KV cache comes back sharded over sp AND tp, and
+    outputs match the single-device engine."""
+
+    def test_tp_sharded_handoff_matches_chunked_prefill(self):
+        from kubeinfer_tpu.inference.sharding import shard_params
+
+        params = _params()
+        mesh = make_inference_mesh(tp=2, sp=2)
+        placed = shard_params(params, mesh, TINY)
+        # the weight-bytes pin: each device holds exactly 1/tp of every
+        # column/row-parallel projection (this is what the r3 warning
+        # said the sp route all-gathered away)
+        q = placed["layers"][0]["q_proj"]
+        shard_bytes = {s.data.nbytes for s in q.addressable_shards}
+        assert shard_bytes == {q.nbytes // 2}, shard_bytes
+
+        prompts = [_prompt(40)]
+        padded, lens, cache_len = prepare_prompts(prompts, 8, 512)
+        prompt = jnp.asarray(padded)
+        plen = jnp.asarray(lens)
+        sp_caches, sp_logits = sp_prefill(placed, prompt, plen, TINY, mesh)
+
+        ref_caches = make_caches(TINY, 1, cache_len, params["norm"].dtype)
+        ref_caches, ref_logits = chunked_prefill(
+            params, prompt, plen, TINY, ref_caches, 16
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp_logits), np.asarray(ref_logits),
+            rtol=2e-4, atol=2e-4,
+        )
+        L = int(lens[0])
+        for (sk, sv), (rk, rv) in zip(sp_caches, ref_caches):
+            np.testing.assert_allclose(
+                np.asarray(sk)[:, :L], np.asarray(rk)[:, :L],
+                rtol=2e-4, atol=2e-4,
+            )
+            np.testing.assert_allclose(
+                np.asarray(sv)[:, :L], np.asarray(rv)[:, :L],
+                rtol=2e-4, atol=2e-4,
+            )
+
+    def test_tp_sharded_generate_matches_engine(self):
+        from kubeinfer_tpu.inference.sharding import shard_params
+
+        params = _params()
+        mesh = make_inference_mesh(tp=2, sp=2)
+        placed = shard_params(params, mesh, TINY)
+        sp = SPEngine(placed, TINY, mesh, min_prompt=8)
+        prompt = _prompt(40, seed=3)
+        out = sp.generate([prompt], max_new_tokens=8)
+        ref = Engine(params, TINY).generate([prompt], max_new_tokens=8)
+        assert out.tokens.tolist() == ref.tokens.tolist()
+        assert out.lengths.tolist() == ref.lengths.tolist()
+
+    def test_tp_must_divide_heads(self):
+        import dataclasses
+
+        params = _params()
+        mesh = make_inference_mesh(tp=2, sp=2)
+        odd = dataclasses.replace(TINY, num_key_value_heads=1,
+                                  num_attention_heads=4)
+        with pytest.raises(ValueError, match="divide"):
+            sp_prefill(
+                params, jnp.zeros((1, 16), jnp.int32),
+                jnp.asarray([16]), odd, mesh,
+            )
