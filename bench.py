@@ -100,7 +100,17 @@ def _chained_solver(req, k, solve_fn=None):
     from kubeinfer_tpu.solver.problem import encode_problem_arrays
 
     if solve_fn is None:
-        solve_fn = solve_greedy
+        # match the production backend: seeding machinery only when the
+        # request carries incumbent placements (shared predicate so the
+        # two call sites cannot drift)
+        import functools as _ft
+
+        from kubeinfer_tpu.scheduler.backends import request_has_incumbents
+
+        solve_fn = _ft.partial(
+            solve_greedy,
+            seeded=request_has_incumbents(req.job_current_node),
+        )
     perm = np.argsort(-req.job_priority, kind="stable")
     p = encode_problem_arrays(
         job_gpu=req.job_gpu[perm],
@@ -108,6 +118,13 @@ def _chained_solver(req, k, solve_fn=None):
         job_priority=req.job_priority[perm],
         job_gang=req.job_gang[perm] if req.job_gang is not None else None,
         job_model=req.job_model[perm],
+        # node indices survive the job-axis permutation unchanged; without
+        # this the seeded machinery would compile in but run inert
+        job_current_node=(
+            req.job_current_node[perm]
+            if req.job_current_node is not None
+            else None
+        ),
         node_gpu_free=req.node_gpu_free,
         node_mem_free_gib=req.node_mem_free_gib,
         node_cached=req.node_cached,

@@ -52,12 +52,30 @@ def _packed_solver():
     from kubeinfer_tpu.solver.problem import unpack_problem
 
     @functools.partial(
-        jax.jit, static_argnames=("J", "N", "policy", "accel")
+        jax.jit, static_argnames=("J", "N", "policy", "accel", "seeded")
     )
-    def solve_packed(buf, J: int, N: int, policy: str, accel: str):
-        return jax_solve(unpack_problem(buf, J, N), policy=policy, accel=accel)
+    def solve_packed(
+        buf, J: int, N: int, policy: str, accel: str, seeded: bool
+    ):
+        return jax_solve(
+            unpack_problem(buf, J, N), policy=policy, accel=accel,
+            seeded=seeded,
+        )
 
     return solve_packed
+
+
+def request_has_incumbents(
+    job_current_node: "np.ndarray | None",
+) -> bool:
+    """Whether a request carries incumbent placements — the single
+    definition both the production backend and bench.py use to decide
+    the solver's static ``seeded`` flag (core.solve_greedy), so the
+    benchmark always measures the same compiled graph production runs.
+    """
+    return job_current_node is not None and bool(
+        np.any(np.asarray(job_current_node) >= 0)
+    )
 
 
 @dataclass
@@ -308,8 +326,15 @@ class JaxBackend(SchedulerBackend):
             job_perm=perm,
         )
         t_encode = time.perf_counter()
+        # Incumbent seeding/preemption-repair machinery is compiled in
+        # only when the request actually carries placements — fresh
+        # solves skip ~0.2ms of inert control flow (core.solve_greedy's
+        # `seeded` note).
+        seeded = request_has_incumbents(req.job_current_node)
         with _profile_ctx():
-            out = _packed_solver()(buf, J=J, N=N, policy=policy, accel="auto")
+            out = _packed_solver()(
+                buf, J=J, N=N, policy=policy, accel="auto", seeded=seeded
+            )
             # ONE host readback for everything the caller needs: each extra
             # sync (a separate np.asarray/int() call) is a full host<->device
             # round trip, which under a remote PJRT relay costs ~65-100ms.

@@ -774,6 +774,8 @@ def _mega_kernel(
     key_ref,  # [1, W] i32 accept key
     rank_ref,  # [1, W] f32 fence rank (RANK_INF for invalid)
     cur_ref,  # [1, W] i32 incumbent node index (-1 = none)
+    asg0_ref,  # [1, W] i32 seeded assignment (-1 = unplaced) — churn
+    #            re-solves seat joint-fitting incumbents up front
     may_ref,  # [1, W] i32 job validity (1 = may bid)
     gf0_ref,  # [N, 1] f32 starting gpu free (invalid nodes folded to -1)
     mf0_ref,  # [N, 1] f32 starting mem free
@@ -827,9 +829,10 @@ def _mega_kernel(
 
     gf_in = gf_ref[:]
     mf_in = mf_ref[:]
-    asg0 = jnp.full(asg_ref.shape, -1, jnp.int32)
-    init_prog = jnp.any(may) & (
-        jnp.min(jnp.where(may, d, jnp.float32(3.4e38)))
+    asg0 = asg0_ref[:]
+    unpl0 = may & (asg0 < 0)
+    init_prog = jnp.any(unpl0) & (
+        jnp.min(jnp.where(unpl0, d, jnp.float32(3.4e38)))
         <= jnp.max(gf_in) + _EPS
     )
     asg, gf, mf, r, prog = jax.lax.while_loop(
@@ -851,6 +854,8 @@ def mega_solve_pallas(
     accept_key: jax.Array,  # i32[J]
     rankf: jax.Array,  # f32[J] fence rank (RANK_INF for invalid)
     current_node: jax.Array,  # i32[J] incumbent node (-1 = none)
+    asg_init: jax.Array,  # i32[J] seeded assignment (-1 = unplaced);
+    #                       gf_eff/mf must already be net of seated jobs
     may_bid: jax.Array,  # bool[J] (valid jobs)
     gf_eff: jax.Array,  # f32[N] (invalid nodes folded to -1)
     mf: jax.Array,  # f32[N]
@@ -904,6 +909,7 @@ def mega_solve_pallas(
             row,  # key
             row,  # rank
             row,  # cur
+            row,  # asg0
             row,  # may
             const_col,  # gf0
             const_col,  # mf0
@@ -935,6 +941,7 @@ def mega_solve_pallas(
         accept_key.reshape(1, J),
         rankf.reshape(1, J),
         current_node.reshape(1, J),
+        asg_init.reshape(1, J),
         may_bid.astype(jnp.int32).reshape(1, J),
         gf_eff.reshape(N, 1),
         mf.reshape(N, 1),
@@ -952,6 +959,7 @@ def mega_rounds_jnp(
     accept_key: jax.Array,
     rankf: jax.Array,
     current_node: jax.Array,
+    asg_init: jax.Array,
     may_bid: jax.Array,
     gf_eff: jax.Array,
     mf: jax.Array,
@@ -977,6 +985,7 @@ def mega_rounds_jnp(
     key2 = accept_key.reshape(1, J)
     rank2 = rankf.reshape(1, J)
     cur2 = current_node.reshape(1, J)
+    asg02 = asg_init.reshape(1, J)
     may2 = may_bid.reshape(1, J)
     gf0 = gf_eff.reshape(N, 1)
     mf0 = mf.reshape(N, 1)
@@ -994,6 +1003,7 @@ def mega_rounds_jnp(
         keyw = jax.lax.dynamic_slice(key2, (0, col), (1, W))
         rankw = jax.lax.dynamic_slice(rank2, (0, col), (1, W))
         curw = jax.lax.dynamic_slice(cur2, (0, col), (1, W))
+        asg0w = jax.lax.dynamic_slice(asg02, (0, col), (1, W))
         mayw = jax.lax.dynamic_slice(may2, (0, col), (1, W))
 
         def cond(carry):
@@ -1010,13 +1020,13 @@ def mega_rounds_jnp(
             )
             return asg, gf, mf_c, r + jnp.int32(1), prog
 
-        init_prog = jnp.any(mayw) & (
-            jnp.min(jnp.where(mayw, dw, jnp.float32(3.4e38)))
+        unpl0 = mayw & (asg0w < 0)
+        init_prog = jnp.any(unpl0) & (
+            jnp.min(jnp.where(unpl0, dw, jnp.float32(3.4e38)))
             <= jnp.max(gf) + _EPS
         )
-        asg0 = jnp.full((1, W), -1, jnp.int32)
         asg, gf, mf_c, r, prog = jax.lax.while_loop(
-            cond, body, (asg0, gf, mf_c, jnp.int32(0), init_prog)
+            cond, body, (asg0w, gf, mf_c, jnp.int32(0), init_prog)
         )
         asg_full = jax.lax.dynamic_update_slice(asg_full, asg, (0, col))
         return asg_full, gf, mf_c, rounds + r, capped | prog

@@ -691,6 +691,28 @@ class TestMegaSerializedGreedy:
         assert int(a.node[0]) == 0, "top-priority job must win the node"
         assert int(a.node[1]) == -1
 
+    def test_shrunk_node_releases_its_incumbents(self):
+        """Seeding validates joint fit per node: a node whose free
+        capacity no longer covers its incumbents releases ALL of them to
+        re-bid (they relocate under the move penalty, not silently
+        overcommit)."""
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        p = encode_problem_arrays(
+            job_gpu=np.array([4.0, 4.0], np.float32),
+            job_mem_gib=np.array([4.0, 4.0], np.float32),
+            job_current_node=np.array([0, 0], np.int32),
+            # node 0 shrank below its incumbents' joint demand
+            node_gpu_free=np.array([6.0, 8.0], np.float32),
+            node_mem_free_gib=np.array([64.0, 64.0], np.float32),
+        )
+        for accel in ("mega-jnp", "mega-interpret"):
+            a = solve_greedy(p, accel=accel)
+            nodes_out = np.asarray(a.node)[:2]
+            assert (nodes_out >= 0).all(), (accel, nodes_out)
+            # no overcommit: they cannot both sit on node 0
+            assert sorted(nodes_out.tolist()) == [0, 1], (accel, nodes_out)
+
     def test_churn_stability(self):
         """Surviving incumbents stay put under 10% churn. Mega carries
         the same home-bid fence exemption as the pipelined path —
