@@ -814,31 +814,31 @@ def solve_greedy(
         # priority job wants — the preemption repair below undoes
         # exactly that case). A node whose incumbents no longer jointly
         # fit releases ALL of them to re-bid.
-        n_iota_seed = jnp.arange(N, dtype=jnp.int32)
+        # Everything seeding-related lives under `if seeded:` so the
+        # "fresh solves trace none of it" claim is structural, not an
+        # inspection exercise.
         if seeded:
+            n_iota_seed = jnp.arange(N, dtype=jnp.int32)
             at_home = (jobs.current_node >= 0) & jobs.valid
-        else:
-            at_home = jnp.zeros((J,), bool)
 
-        def _seat_sums(_):
-            on_node = (
-                jobs.current_node[None, :] == n_iota_seed[:, None]
-            ) & at_home[None, :]
-            return (
-                jnp.sum(
-                    jnp.where(on_node, jobs.gpu_demand[None, :], 0.0),
-                    axis=1,
-                ),
-                jnp.sum(
-                    jnp.where(on_node, jobs.mem_demand[None, :], 0.0),
-                    axis=1,
-                ),
-            )
+            def _seat_sums(_):
+                on_node = (
+                    jobs.current_node[None, :] == n_iota_seed[:, None]
+                ) & at_home[None, :]
+                return (
+                    jnp.sum(
+                        jnp.where(on_node, jobs.gpu_demand[None, :], 0.0),
+                        axis=1,
+                    ),
+                    jnp.sum(
+                        jnp.where(on_node, jobs.mem_demand[None, :], 0.0),
+                        axis=1,
+                    ),
+                )
 
-        # cond-skipped on fresh solves: the two [N, J] seat-sum reduces
-        # cost ~0.15ms at the headline shape and incumbents only exist
-        # on churn re-solves
-        if seeded:
+            # cond-skipped when the request carried placements but all
+            # rows are -1: the two [N, J] seat-sum reduces cost ~0.15ms
+            # at the headline shape
             used_g, used_m = lax.cond(
                 jnp.any(at_home),
                 _seat_sums,
@@ -858,7 +858,6 @@ def solve_greedy(
             gf_seed = gf_valid - jnp.where(ok_node, used_g, 0.0)
             mf_seed = nodes.mem_free - jnp.where(ok_node, used_m, 0.0)
         else:
-            seated = jnp.zeros((J,), bool)
             asg_init = jnp.full((J,), -1, jnp.int32)
             gf_seed = gf_valid
             mf_seed = nodes.mem_free
@@ -1004,14 +1003,15 @@ def solve_greedy(
         if accel in ("mega", "mega-interpret", "mega-jnp"):
             # Fill through the mega kernel too: at the 50k soak shape
             # the pipelined fill (48 J tiles x several rounds) dominated
-            # the whole device solve. ``may_bid`` restricts bidding to
-            # the fillable set (mega always solves from an empty
-            # assignment, so non-fillable rows come back -1 and are
-            # merged over); the per-window cap is W+1 — every progress
-            # round places >= 1 job, so the in-kernel while reaches its
-            # fixpoint first, preserving the fill's completeness
-            # guarantee (a 64-cap could re-strand a node contested by
-            # more small jobs than the cap).
+            # the whole device solve. The current assignment seeds the
+            # kernel (asg_init) and ``may_bid`` restricts bidding to the
+            # fillable set, so the kernel's output IS the merged result
+            # (the round math never unassigns a placed job); the
+            # per-window cap is W+1 — every progress round places >= 1
+            # job, so the in-kernel while reaches its fixpoint first,
+            # preserving the fill's completeness guarantee (a 64-cap
+            # could re-strand a node contested by more small jobs than
+            # the cap).
             from kubeinfer_tpu.solver import pallas_kernels as pk
 
             fill_fn = (

@@ -255,3 +255,33 @@ class TestSPTimesTP:
                 params, jnp.zeros((1, 16), jnp.int32),
                 jnp.asarray([16]), odd, mesh,
             )
+
+    def test_tied_embeddings_full_vocab_logits(self):
+        """Tied-embedding models keep full-vocab logits on every device
+        (the embed table is replicated; there is no lm_head to vocab-
+        shard) — the sp_prefill out_spec branch the vocab-sharded tests
+        never touch."""
+        import dataclasses
+
+        from kubeinfer_tpu.inference.sharding import shard_params
+
+        cfg = dataclasses.replace(TINY, tie_word_embeddings=True)
+        params = init_params(cfg, jax.random.PRNGKey(4))
+        params.pop("lm_head", None)
+        mesh = make_inference_mesh(tp=2, sp=2)
+        placed = shard_params(params, mesh, cfg)
+        prompts = [_prompt(40, seed=9)]
+        padded, lens, cache_len = prepare_prompts(prompts, 8, 512)
+        sp_caches, sp_logits = sp_prefill(
+            placed, jnp.asarray(padded), jnp.asarray(lens), cfg, mesh
+        )
+        assert sp_logits.shape == (1, cfg.vocab_size)
+        ref_caches = make_caches(cfg, 1, cache_len, params["norm"].dtype)
+        ref_caches, ref_logits = chunked_prefill(
+            params, jnp.asarray(padded), jnp.asarray(lens), cfg,
+            ref_caches, 16
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp_logits), np.asarray(ref_logits),
+            rtol=2e-4, atol=2e-4,
+        )
