@@ -869,100 +869,129 @@ def solve_greedy(
             q_max=q_max, node_idx_bits=node_idx_bits,
         )
 
-        # Preemption repair: seeding holds incumbents' homes before any
-        # window bids, which re-admits the squat inversion — a seated
-        # low-priority incumbent keeping capacity that leaves a HIGHER-
-        # priority job unplaceable. (Jobs placed by the windows cannot
-        # cause this: a job unplaced at its own window's fixpoint found
-        # no node feasible, and later, lower-priority windows only
-        # shrink capacity further.) When that exact case occurs, unseat
-        # the lower-rank seats on the victim job's best reclaimable node
-        # and re-run the (now mostly-seeded, cheap) solve; the evictees
-        # re-bid like churn departures. One repair pass rescues the
-        # highest-priority stranded job — the accept key's (rank,
-        # demand-desc, index) order picks it — which is the semantic the
-        # priority tests pin; cascaded multi-victim scenarios fall back
-        # to the next tick's re-solve.
-        def _preempt_repair(args):
-            assigned, gpu_free, mem_free, rounds, capped = args
-            unpl = jobs.valid & (assigned < 0)
-            BIGK = jnp.int32(0x7FFFFFFF)
-            jkey = jnp.where(unpl, accept_key, BIGK)
-            j_star = jnp.argmin(jkey).astype(jnp.int32)
-            d_star = jobs.gpu_demand[j_star]
-            md_star = jobs.mem_demand[j_star]
-            r_star = rankf[j_star]
-            on_seat = seated & (assigned == jobs.current_node)
-            victim = on_seat & (rankf > r_star)
-            vic_on = (
-                jobs.current_node[None, :] == n_iota_seed[:, None]
-            ) & victim[None, :]
-            freed_g = jnp.sum(
-                jnp.where(vic_on, jobs.gpu_demand[None, :], 0.0), axis=1
-            )
-            freed_m = jnp.sum(
-                jnp.where(vic_on, jobs.mem_demand[None, :], 0.0), axis=1
-            )
-            can = (
-                nodes.valid
-                & (d_star <= gpu_free + freed_g + _EPS)
-                & (md_star <= mem_free + freed_m + _EPS)
-                & (freed_g + freed_m > 0.0)
-            )
-            scol = lax.dynamic_slice(
-                S, (jnp.int32(0), j_star), (N, 1)
-            )[:, 0]
-            n_star = jnp.argmin(
-                jnp.where(can, scol, jnp.float32(3.4e38))
-            ).astype(jnp.int32)
-
-            def _unseat_and_resolve(args):
-                assigned, gpu_free, mem_free, rounds, capped = args
-                unseat = victim & (jobs.current_node == n_star)
-                assigned = jnp.where(unseat, -1, assigned)
-                gpu_free = jnp.where(
-                    n_iota_seed == n_star, gpu_free + freed_g, gpu_free
-                )
-                mem_free = jnp.where(
-                    n_iota_seed == n_star, mem_free + freed_m, mem_free
-                )
-                assigned, gpu_free, mem_free, r2, capped2 = mega_fn(
-                    S, jobs.gpu_demand, jobs.mem_demand, accept_key,
-                    rankf, jobs.current_node, assigned, jobs.valid,
-                    jnp.where(nodes.valid, gpu_free, -1.0), mem_free,
-                    v_g, v_m,
-                    max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
-                    q_max=q_max, node_idx_bits=node_idx_bits,
-                )
-                # the re-solve can itself exhaust a window budget; the
-                # repair/fill safety net must see that, not the stale
-                # first-run flag
-                return (
-                    assigned, gpu_free, mem_free, rounds + r2,
-                    capped | capped2,
-                )
-
-            # no reclaimable node fits the stranded job: nothing to
-            # unseat, and re-running the solve would burn a full
-            # window sweep for a guaranteed-identical assignment
-            return lax.cond(
-                jnp.any(can), _unseat_and_resolve, lambda a: a,
-                (assigned, gpu_free, mem_free, rounds, capped),
-            )
-
+        # The repair (like the seeding it repairs) exists only on
+        # seeded compiles — fresh solves trace none of it.
         if seeded:
-            unpl_now = jobs.valid & (assigned < 0)
-            min_unpl_rank = jnp.min(
-                jnp.where(unpl_now, rankf, RANK_INF)
-            )
-            squat_possible = jnp.any(
-                seated
-                & (assigned == jobs.current_node)
-                & (rankf > min_unpl_rank)
-            )
-            assigned, gpu_free, mem_free, rounds, mega_capped = lax.cond(
-                squat_possible, _preempt_repair, lambda a: a,
-                (assigned, gpu_free, mem_free, rounds, mega_capped),
+            # Preemption repair: seeding holds incumbents' homes before any
+            # window bids, which re-admits the squat inversion — a seated
+            # low-priority incumbent keeping capacity that leaves a HIGHER-
+            # priority job unplaceable. (Jobs placed by the windows cannot
+            # cause this: a job unplaced at its own window's fixpoint found
+            # no node feasible, and later, lower-priority windows only
+            # shrink capacity further.) When that exact case occurs, unseat
+            # the lower-rank seats on the victim job's best reclaimable node
+            # and re-run the (now mostly-seeded, cheap) solve; the evictees
+            # re-bid like churn departures. Each iteration rescues the
+            # highest-priority stranded job — the accept key's (rank,
+            # demand-desc, index) order picks it. Termination is made
+            # monotone by the ``ever`` mask: only never-yet-unseated seats
+            # are victimizable, and every productive iteration marks >= 1
+            # new seat (any(can) requires nonzero freeable demand), so the
+            # loop runs at most #seated iterations — a job rescued back
+            # onto its own seat cannot be re-victimized (which doubles as
+            # repeat-churn protection for evictees), and unseating can
+            # never cycle. The it < J cap is a pure backstop. Exit property
+            # (fuzz-tested): the top-priority unplaced job cannot be fitted
+            # by unseating any single node's victimizable lower-rank seats.
+            def _preempt_repair(args):
+                assigned, gpu_free, mem_free, rounds, capped, it, _, ever = args
+                unpl = jobs.valid & (assigned < 0)
+                BIGK = jnp.int32(0x7FFFFFFF)
+                jkey = jnp.where(unpl, accept_key, BIGK)
+                j_star = jnp.argmin(jkey).astype(jnp.int32)
+                d_star = jobs.gpu_demand[j_star]
+                md_star = jobs.mem_demand[j_star]
+                r_star = rankf[j_star]
+                on_seat = seated & (assigned == jobs.current_node) & ~ever
+                victim = on_seat & (rankf > r_star)
+                vic_on = (
+                    jobs.current_node[None, :] == n_iota_seed[:, None]
+                ) & victim[None, :]
+                freed_g = jnp.sum(
+                    jnp.where(vic_on, jobs.gpu_demand[None, :], 0.0), axis=1
+                )
+                freed_m = jnp.sum(
+                    jnp.where(vic_on, jobs.mem_demand[None, :], 0.0), axis=1
+                )
+                can = (
+                    nodes.valid
+                    & (d_star <= gpu_free + freed_g + _EPS)
+                    & (md_star <= mem_free + freed_m + _EPS)
+                    & (freed_g + freed_m > 0.0)
+                )
+                scol = lax.dynamic_slice(
+                    S, (jnp.int32(0), j_star), (N, 1)
+                )[:, 0]
+                n_star = jnp.argmin(
+                    jnp.where(can, scol, jnp.float32(3.4e38))
+                ).astype(jnp.int32)
+
+                def _unseat_and_resolve(args):
+                    (
+                        assigned, gpu_free, mem_free, rounds, capped, it, _,
+                        ever,
+                    ) = args
+                    unseat = victim & (jobs.current_node == n_star)
+                    ever = ever | unseat
+                    assigned = jnp.where(unseat, -1, assigned)
+                    gpu_free = jnp.where(
+                        n_iota_seed == n_star, gpu_free + freed_g, gpu_free
+                    )
+                    mem_free = jnp.where(
+                        n_iota_seed == n_star, mem_free + freed_m, mem_free
+                    )
+                    assigned, gpu_free, mem_free, r2, capped2 = mega_fn(
+                        S, jobs.gpu_demand, jobs.mem_demand, accept_key,
+                        rankf, jobs.current_node, assigned, jobs.valid,
+                        jnp.where(nodes.valid, gpu_free, -1.0), mem_free,
+                        v_g, v_m,
+                        max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
+                        q_max=q_max, node_idx_bits=node_idx_bits,
+                    )
+                    # the re-solve can itself exhaust a window budget; the
+                    # repair/fill safety net must see that, not the stale
+                    # first-run flag
+                    return (
+                        assigned, gpu_free, mem_free, rounds + r2,
+                        capped | capped2, it + jnp.int32(1), jnp.bool_(True),
+                        ever,
+                    )
+
+                # No reclaimable node fits the TOP stranded job: stop (the
+                # progress flag ends the loop) rather than burn a window
+                # sweep for a guaranteed-identical assignment. Lower-ranked
+                # stranded jobs are not attempted past a stuck top job —
+                # they would demand even more reclaim.
+                return lax.cond(
+                    jnp.any(can), _unseat_and_resolve,
+                    lambda a: (*a[:6], jnp.bool_(False), a[7]),
+                    (assigned, gpu_free, mem_free, rounds, capped, it,
+                     jnp.bool_(True), ever),
+                )
+
+            def _repair_cond(args):
+                assigned, _, _, _, _, it, progress, ever = args
+                unpl_now = jobs.valid & (assigned < 0)
+                min_unpl_rank = jnp.min(
+                    jnp.where(unpl_now, rankf, RANK_INF)
+                )
+                squat = jnp.any(
+                    seated
+                    & (assigned == jobs.current_node)
+                    & ~ever
+                    & (rankf > min_unpl_rank)
+                )
+                # the #seated bound comes from the ever-mask monotonicity
+                # argument above; the explicit cap is a backstop, not a
+                # budget
+                return squat & progress & (it < jnp.int32(J))
+
+            (
+                assigned, gpu_free, mem_free, rounds, mega_capped, _, _, _
+            ) = lax.while_loop(
+                _repair_cond, _preempt_repair,
+                (assigned, gpu_free, mem_free, rounds, mega_capped,
+                 jnp.int32(0), jnp.bool_(True), jnp.zeros((J,), bool)),
             )
     else:
         assigned, gpu_free, mem_free, rounds, _ = run_rounds(

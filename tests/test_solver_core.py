@@ -713,6 +713,93 @@ class TestMegaSerializedGreedy:
             # no overcommit: they cannot both sit on node 0
             assert sorted(nodes_out.tolist()) == [0, 1], (accel, nodes_out)
 
+    @pytest.mark.parametrize("seed", range(5))
+    def test_preemption_repair_fuzz(self, seed):
+        """Property of the seeded solve + one-shot preemption repair: at
+        exit, the HIGHEST-priority unplaced job (the repair's target
+        selection) cannot be made to fit by unseating the strictly-
+        lower-rank incumbents of any single node. Random tight instances
+        with incumbents + arrivals; also re-checks overcommit."""
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+        from kubeinfer_tpu.solver.core import _EPS
+
+        rng = np.random.default_rng(200 + seed)
+        J = int(rng.integers(40, 160))
+        N = int(rng.integers(3, 12))
+        cap = float(rng.integers(8, 24))
+        pr = -np.sort(-rng.integers(0, 6, J).astype(np.float32))
+        cur = np.where(
+            rng.random(J) < 0.5, rng.integers(0, N, J), -1
+        ).astype(np.int32)
+        kw = dict(
+            job_gpu=rng.integers(1, max(2, int(cap // 2)), J).astype(
+                np.float32
+            ),
+            job_mem_gib=rng.integers(1, 16, J).astype(np.float32),
+            job_priority=pr,
+            job_current_node=cur,
+            node_gpu_free=np.full(N, cap, np.float32),
+            node_mem_free_gib=np.full(N, 128.0, np.float32),
+        )
+        p = encode_problem_arrays(**kw)
+        a = solve_greedy(p, accel="mega-jnp")
+        assigned = np.asarray(a.node)[:J]
+        gf = np.asarray(a.gpu_free)[:N]
+        mf = np.asarray(a.mem_free)[:N]
+        for n in range(N):
+            assert kw["job_gpu"][assigned == n].sum() <= cap + 1e-3
+
+        # crank mirror of the solver's 4-class compression
+        n_classes = len(np.unique(pr))
+        dense = np.unique(-pr, return_inverse=True)[1]
+        crank = np.minimum(dense * 4 // max(n_classes, 1), 3)
+        unpl = np.nonzero(assigned < 0)[0]
+        if unpl.size == 0:
+            return
+        # The repair targets the minimum ACCEPT KEY (full priority rank,
+        # then demand DESCENDING, then index) — its exit property holds
+        # for that job, so the mirror must select identically.
+        dmax = max(kw["job_gpu"].max(), 1.0)
+        demand_q = np.clip(
+            kw["job_gpu"] * (15.0 / dmax), 0, 15
+        ).astype(np.int64)
+        jkey = (dense.astype(np.int64) << 40) | (
+            (15 - demand_q) << 20
+        ) | np.arange(J, dtype=np.int64)
+        j_star = unpl[np.argmin(jkey[unpl])]
+        # mirror of the solver's seating rule: only jobs seeded by the
+        # per-node JOINT-fit check are unseatable victims (a job that
+        # re-bid its old home through the rounds is not seated)
+        at_home = cur >= 0
+        ok_node = np.array([
+            kw["job_gpu"][at_home & (cur == n)].sum() <= cap + 1e-4
+            and kw["job_mem_gib"][at_home & (cur == n)].sum()
+            <= 128.0 + 1e-4
+            for n in range(N)
+        ])
+        seated_mask = (
+            at_home
+            & ok_node[np.clip(cur, 0, N - 1)]
+            & (assigned == cur)
+        )
+        for n in range(N):
+            victims = (
+                seated_mask
+                & (cur == n)
+                & (crank > crank[j_star])
+            )
+            freed_g = kw["job_gpu"][victims].sum()
+            freed_m = kw["job_mem_gib"][victims].sum()
+            if freed_g + freed_m == 0:
+                continue
+            fits = (
+                kw["job_gpu"][j_star] <= gf[n] + freed_g + _EPS
+                and kw["job_mem_gib"][j_star] <= mf[n] + freed_m + _EPS
+            )
+            assert not fits, (
+                seed, int(j_star), n, "repair left a reclaimable node"
+            )
+
     def test_churn_stability(self):
         """Surviving incumbents stay put under 10% churn. Mega carries
         the same home-bid fence exemption as the pipelined path —
