@@ -33,13 +33,29 @@ class ModelConfig:
     # top-k routed mixture of SwiGLU experts (moe.py)
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Gemma-family deltas from the llama recipe: tanh-approx GeGLU
+    # instead of SwiGLU ("gelu_pytorch_tanh"), embeddings scaled by
+    # sqrt(hidden_size) on the way in, and RMSNorm weights stored as an
+    # OFFSET from 1 (x_norm * (1 + w), zero-init) rather than a gain
+    hidden_act: str = "silu"
+    scale_embeddings: bool = False
+    rmsnorm_offset: bool = False
+    # Explicit head width for families where it is NOT
+    # hidden_size/num_heads (gemma-7b: 16 heads x 256 on a 3072 hidden —
+    # the q/o projections are then [H, heads*head_dim] rectangles, which
+    # the decoder already handles generically). 0 = derive.
+    head_dim_override: int = 0
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
     def __post_init__(self) -> None:
-        if self.hidden_size % self.num_attention_heads:
+        if not self.head_dim_override and (
+            self.hidden_size % self.num_attention_heads
+        ):
             raise ValueError("hidden_size must divide by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads:
             raise ValueError(
@@ -70,10 +86,22 @@ class ModelConfig:
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             rope_theta=d.get("rope_theta", 10000.0),
             max_position_embeddings=d.get("max_position_embeddings", 4096),
-            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            tie_word_embeddings=d.get("tie_word_embeddings", False)
+            or d.get("model_type") == "gemma",
             qkv_bias=d.get("model_type") == "qwen2",
             num_local_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            hidden_act=(
+                "gelu_pytorch_tanh"
+                if d.get("model_type") == "gemma"
+                else d.get("hidden_act", "silu")
+            ),
+            scale_embeddings=d.get("model_type") == "gemma",
+            rmsnorm_offset=d.get("model_type") == "gemma",
+            head_dim_override=d.get("head_dim", 0)
+            if d.get("head_dim", 0)
+            != d["hidden_size"] // d["num_attention_heads"]
+            else 0,
         )
 
 
@@ -111,6 +139,49 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=1000000.0,
         max_position_embeddings=32768,
         qkv_bias=True,
+    ),
+    "tiny-gemma": ModelConfig(  # demo/e2e-sized gemma-family config
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=1,
+        rms_norm_eps=1e-6,
+        max_position_embeddings=512,
+        tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        rmsnorm_offset=True,
+    ),
+    "gemma-2b": ModelConfig(
+        vocab_size=256000,
+        hidden_size=2048,
+        intermediate_size=16384,
+        num_hidden_layers=18,
+        num_attention_heads=8,
+        num_key_value_heads=1,  # multi-query attention
+        rms_norm_eps=1e-6,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        rmsnorm_offset=True,
+    ),
+    "gemma-7b": ModelConfig(
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_hidden_layers=28,
+        num_attention_heads=16,
+        num_key_value_heads=16,
+        head_dim_override=256,  # 16 x 256 = 4096-wide q/o on 3072 hidden
+        rms_norm_eps=1e-6,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        rmsnorm_offset=True,
     ),
     "mixtral-8x7b": ModelConfig(
         vocab_size=32000,

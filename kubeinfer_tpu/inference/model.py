@@ -44,18 +44,23 @@ def init_params(
 
     H, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     kv_dim = cfg.num_key_value_heads * cfg.head_dim
+    q_dim = cfg.num_attention_heads * cfg.head_dim
+    # gemma stores norm weights as a zero-init offset from gain 1
+    norm_init = jnp.zeros if cfg.rmsnorm_offset else jnp.ones
     layers = []
     for i in range(cfg.num_hidden_layers):
         ks = jax.random.split(jax.random.fold_in(k_layers, i), 7)
         layer = {
-            "input_layernorm": jnp.ones((H,), dtype),
-            "post_attention_layernorm": jnp.ones((H,), dtype),
+            "input_layernorm": norm_init((H,), dtype),
+            "post_attention_layernorm": norm_init((H,), dtype),
             # weights stored [in, out] (transposed vs torch Linear) so
             # the forward is x @ W with no per-call transpose
-            "q_proj": dense(ks[0], (H, H)),
+            # q/o are [H, heads*head_dim] RECTANGLES when head_dim is
+            # overridden (gemma-7b); square for every derived-head family
+            "q_proj": dense(ks[0], (H, q_dim)),
             "k_proj": dense(ks[1], (H, kv_dim)),
             "v_proj": dense(ks[2], (H, kv_dim)),
-            "o_proj": dense(ks[3], (H, H)),
+            "o_proj": dense(ks[3], (q_dim, H)),
         }
         if cfg.num_local_experts > 0:  # Mixtral family: routed MLP
             from kubeinfer_tpu.inference.moe import init_moe_params
@@ -69,14 +74,14 @@ def init_params(
             layer["up_proj"] = dense(ks[5], (H, F))
             layer["down_proj"] = dense(ks[6], (F, H))
         if cfg.qkv_bias:  # Qwen2 family
-            layer["q_bias"] = jnp.zeros((H,), dtype)
+            layer["q_bias"] = jnp.zeros((q_dim,), dtype)
             layer["k_bias"] = jnp.zeros((kv_dim,), dtype)
             layer["v_bias"] = jnp.zeros((kv_dim,), dtype)
         layers.append(layer)
     params: Params = {
         "embed_tokens": dense(k_embed, (V, H)),
         "layers": layers,
-        "norm": jnp.ones((H,), dtype),
+        "norm": norm_init((H,), dtype),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(k_head, (H, V))
@@ -122,11 +127,33 @@ def layer_param_template(cfg: ModelConfig) -> dict:
 # --- building blocks -------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm with f32 statistics regardless of activation dtype."""
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
+    """RMSNorm with f32 statistics regardless of activation dtype.
+
+    ``offset`` selects the Gemma convention: the stored weight is a
+    zero-init delta and the gain is (1 + w) — folding it into the weight
+    at load time would silently corrupt checkpoints saved back out, so
+    the convention is applied at compute time.
+    """
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return ((xf * scale) * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return ((xf * scale) * w).astype(x.dtype)
+
+
+def _mlp_act(cfg: ModelConfig):
+    """The gated-MLP activation for this family: llama/qwen2/mixtral use
+    SwiGLU (silu); gemma uses the tanh-approximate GeGLU
+    ("gelu_pytorch_tanh" — exactly jax.nn.gelu(approximate=True))."""
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu
+    if cfg.hidden_act == "gelu_pytorch_tanh":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
 
 
 def rope_tables(
@@ -204,7 +231,10 @@ def decoder_layer(
     D = cfg.head_dim
     n_q = cfg.num_attention_heads // tp_size
     n_kv = cfg.num_key_value_heads // tp_size
-    h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+    h = rms_norm(
+        x, layer["input_layernorm"], cfg.rms_norm_eps,
+        offset=cfg.rmsnorm_offset,
+    )
     q, k, v = h @ layer["q_proj"], h @ layer["k_proj"], h @ layer["v_proj"]
     if cfg.qkv_bias:  # Qwen2 family; o_proj stays bias-free
         q = q + layer["q_bias"]
@@ -243,7 +273,10 @@ def decoder_layer(
         attn_out = jax.lax.psum(attn_out, tp_axis)
     x = x + attn_out
 
-    h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+    h = rms_norm(
+        x, layer["post_attention_layernorm"], cfg.rms_norm_eps,
+        offset=cfg.rmsnorm_offset,
+    )
     if "moe" in layer:  # Mixtral family (static: pytree structure)
         from kubeinfer_tpu.inference.moe import moe_block
 
@@ -257,7 +290,7 @@ def decoder_layer(
             m = jax.lax.psum(m, tp_axis)
         x = x + m
     else:
-        gate = jax.nn.silu(h @ layer["gate_proj"])
+        gate = _mlp_act(cfg)(h @ layer["gate_proj"])
         mlp = (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
@@ -333,6 +366,13 @@ def forward(
 
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     x = params["embed_tokens"][tokens]
+    if cfg.scale_embeddings:
+        # Gemma scales embeddings into the residual stream; the HF
+        # reference casts the sqrt(H) normalizer to the activation dtype
+        # BEFORE multiplying — mirrored for checkpoint-level parity
+        x = x * jnp.asarray(
+            float(cfg.hidden_size) ** 0.5, x.dtype
+        )
     new_caches = [] if kv_caches is not None else None
     for i, layer in enumerate(params["layers"]):
         cache = kv_caches[i] if kv_caches is not None else None
@@ -343,7 +383,9 @@ def forward(
         )
         if new_caches is not None:
             new_caches.append(cache)
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    x = rms_norm(
+        x, params["norm"], cfg.rms_norm_eps, offset=cfg.rmsnorm_offset
+    )
     head = (
         params["embed_tokens"].T
         if cfg.tie_word_embeddings

@@ -92,7 +92,12 @@ def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
             return x
 
         def embed(mb):
-            return other["embed_tokens"][mb]
+            x = other["embed_tokens"][mb]
+            if cfg.scale_embeddings:  # gemma residual-stream scaling
+                x = x * jnp.asarray(
+                    float(cfg.hidden_size) ** 0.5, x.dtype
+                )
+            return x
 
         mbs = toks.reshape(M, B // M, T)
         H = cfg.hidden_size
@@ -140,7 +145,8 @@ def _pp_fn(cfg: ModelConfig, mesh: Mesh, M: int, tied: bool):
         # norm + head ONCE over the full batch
         acts = lax.psum(jnp.where(p == PP - 1, acts, 0.0), "pp")
         x = rms_norm(
-            acts.reshape(B, T, H), other["norm"], cfg.rms_norm_eps
+            acts.reshape(B, T, H), other["norm"], cfg.rms_norm_eps,
+            offset=cfg.rmsnorm_offset,
         )
         h = (
             other["embed_tokens"].T

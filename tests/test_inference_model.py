@@ -317,3 +317,163 @@ class TestMixtralParity:
         engine = Engine(params, self.TINY_MIX)
         out = engine.generate([[1, 2, 3, 4]], max_new_tokens=3)
         assert out.tokens.shape == (1, 3)
+
+
+class TestGemmaParity:
+    """Gemma family: tied embeddings scaled by sqrt(H) into the residual
+    stream, tanh-approx GeGLU, offset RMSNorm (gain = 1 + w), MQA."""
+
+    TINY_GEMMA = ModelConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=1,  # multi-query, like gemma-2b
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        rmsnorm_offset=True,
+    )
+
+    @pytest.fixture(scope="class")
+    def hf_gemma(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        cfg = self.TINY_GEMMA
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            # explicit: GemmaConfig defaults head_dim to 256 regardless
+            # of hidden_size/heads
+            head_dim=cfg.head_dim,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=True,
+            hidden_act="gelu_pytorch_tanh",
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(0)
+        return torch, transformers.GemmaForCausalLM(hf_cfg).eval()
+
+    def test_logits_match_transformers(self, hf_gemma):
+        torch, model = hf_gemma
+        params = params_from_state_dict(
+            model.state_dict(), self.TINY_GEMMA, dtype=jnp.float32
+        )
+        assert "lm_head" not in params  # tied
+        toks = tokens_for(self.TINY_GEMMA, B=2, T=16, seed=5)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+        ours, _ = forward(params, jnp.asarray(toks), self.TINY_GEMMA)
+        np.testing.assert_allclose(
+            np.asarray(ours), ref, rtol=2e-4, atol=2e-4
+        )
+
+    def test_from_hf_dict_flags_gemma(self):
+        cfg = ModelConfig.from_hf_dict(
+            {
+                "model_type": "gemma",
+                "vocab_size": 256000,
+                "hidden_size": 2048,
+                "intermediate_size": 16384,
+                "num_hidden_layers": 18,
+                "num_attention_heads": 8,
+                "num_key_value_heads": 1,
+            }
+        )
+        assert cfg.tie_word_embeddings
+        assert cfg.scale_embeddings
+        assert cfg.rmsnorm_offset
+        assert cfg.hidden_act == "gelu_pytorch_tanh"
+
+    def test_generate_smoke(self):
+        """The engine stack (prefill + decode cache) runs the gemma
+        config end to end — catches family-specific shape breaks (MQA
+        n_kv=1, tied head) outside the pure forward."""
+        from kubeinfer_tpu.inference.engine import Engine
+
+        params = init_params(self.TINY_GEMMA, jax.random.PRNGKey(1))
+        eng = Engine(params, self.TINY_GEMMA, max_cache_len=64)
+        out = eng.generate([[3, 5, 7, 9]], max_new_tokens=6)
+        assert out.tokens.shape == (1, 6)
+        assert out.lengths[0] == 6
+
+    def test_rectangular_head_dim_matches_transformers(self):
+        """gemma-7b's geometry: head_dim overridden (heads*head_dim !=
+        hidden), making q/o projections rectangles — pinned against HF
+        with the same override."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        cfg = ModelConfig(
+            vocab_size=256,
+            hidden_size=48,
+            intermediate_size=96,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim_override=16,  # 4 x 16 = 64-wide q/o on 48 hidden
+            rms_norm_eps=1e-6,
+            max_position_embeddings=512,
+            tie_word_embeddings=True,
+            hidden_act="gelu_pytorch_tanh",
+            scale_embeddings=True,
+            rmsnorm_offset=True,
+        )
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            head_dim=16,
+            rms_norm_eps=cfg.rms_norm_eps,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=True,
+            hidden_act="gelu_pytorch_tanh",
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(2)
+        model = transformers.GemmaForCausalLM(hf_cfg).eval()
+        params = params_from_state_dict(
+            model.state_dict(), cfg, dtype=jnp.float32
+        )
+        assert params["layers"][0]["q_proj"].shape == (48, 64)
+        toks = tokens_for(cfg, B=1, T=12, seed=6)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+        ours, _ = forward(params, jnp.asarray(toks), cfg)
+        np.testing.assert_allclose(
+            np.asarray(ours), ref, rtol=2e-4, atol=2e-4
+        )
+
+    def test_pipeline_forward_matches_dense(self):
+        """pipeline_forward must carry the gemma flags too (embedding
+        scale + offset final norm happen OUTSIDE decoder_layer there)."""
+        from kubeinfer_tpu.inference.pipeline import (
+            make_pp_mesh,
+            pipeline_forward,
+        )
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        params = init_params(self.TINY_GEMMA, jax.random.PRNGKey(3))
+        toks = tokens_for(self.TINY_GEMMA, B=2, T=8, seed=7)
+        want, _ = forward(params, jnp.asarray(toks), self.TINY_GEMMA)
+        mesh = make_pp_mesh(2)
+        got = pipeline_forward(
+            params, jnp.asarray(toks), self.TINY_GEMMA, mesh, n_microbatches=2
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want, np.float32),
+            rtol=2e-4, atol=2e-4,
+        )
