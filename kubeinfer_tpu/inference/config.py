@@ -12,6 +12,20 @@ from dataclasses import dataclass
 from typing import Any
 
 
+def _hf_head_dim_override(d: dict) -> int:
+    """Explicit head width from a HF config dict, 0 when derivable.
+
+    GemmaConfig defaults head_dim to 256 REGARDLESS of
+    hidden_size/num_heads, so a gemma config.json that omits the key
+    still means 256 — deriving it would build a wrong-geometry model
+    whose q reshape fails against the checkpoint's 256-wide heads.
+    """
+    derived = d["hidden_size"] // d["num_attention_heads"]
+    default = 256 if d.get("model_type") == "gemma" else derived
+    hd = d.get("head_dim", default)
+    return hd if hd != derived else 0
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     vocab_size: int = 32000
@@ -57,6 +71,14 @@ class ModelConfig:
             self.hidden_size % self.num_attention_heads
         ):
             raise ValueError("hidden_size must divide by num_attention_heads")
+        # refuse-at-config-time (same convention as the attention_bias
+        # check in from_hf_dict): an unknown activation would otherwise
+        # only raise mid-jit-trace inside the first forward
+        if self.hidden_act not in ("silu", "gelu_pytorch_tanh"):
+            raise ValueError(
+                f"unsupported hidden_act {self.hidden_act!r} "
+                "(silu and gelu_pytorch_tanh are implemented)"
+            )
         if self.num_attention_heads % self.num_key_value_heads:
             raise ValueError(
                 "num_attention_heads must divide by num_key_value_heads"
@@ -98,10 +120,7 @@ class ModelConfig:
             ),
             scale_embeddings=d.get("model_type") == "gemma",
             rmsnorm_offset=d.get("model_type") == "gemma",
-            head_dim_override=d.get("head_dim", 0)
-            if d.get("head_dim", 0)
-            != d["hidden_size"] // d["num_attention_heads"]
-            else 0,
+            head_dim_override=_hf_head_dim_override(d),
         )
 
 

@@ -148,14 +148,19 @@ def _flash_kernel(
 
 def _flash_ragged_kernel(
     c0_ref,  # SMEM i32[1]: global position of the first query row
-    len_ref,  # SMEM i32[1]: this batch row's valid sequence length
+    len_ref,  # SMEM i32[B]: per-row valid sequence lengths (whole vector:
+    #           Mosaic rank-1 blocks must equal the array or tile to 128,
+    #           so a (1,)-block per batch row only lowers at B == 1 —
+    #           indexed in-kernel by program_id instead)
     q_ref, k_ref, v_ref,
     o_ref, m_scr, l_scr, acc_scr,
     *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+    n_kv: int,
 ):
     """The engine's prefill mask — attend cache slots <= own global
     position AND < the row's valid length — from iotas on two scalars
     instead of a shipped [B, T, S] int8 tensor."""
+    row_len = len_ref[pl.program_id(0) // n_kv]
     tq = pl.program_id(1)
     ts = pl.program_id(2)
     q_pos = (
@@ -165,7 +170,7 @@ def _flash_ragged_kernel(
     s_pos = ts * tile_s + jax.lax.broadcasted_iota(
         jnp.int32, (tile_t, tile_s), 1
     )
-    attend = (s_pos <= q_pos) & (s_pos < len_ref[0])
+    attend = (s_pos <= q_pos) & (s_pos < row_len)
     pen = jnp.where(attend, 0.0, -1e30)  # i1 never changes rank
     _softmax_fold(
         q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
@@ -290,19 +295,22 @@ def flash_attention_ragged(
     n_kv = k.shape[2]
     tt = min(tile_t, q.shape[1])
     ts_ = min(tile_s, k.shape[1])
-    kern = functools.partial(_flash_ragged_kernel, tile_t=tt, tile_s=ts_)
+    lens = jnp.asarray(row_lens, jnp.int32)
+    kern = functools.partial(
+        _flash_ragged_kernel, tile_t=tt, tile_s=ts_, n_kv=n_kv
+    )
     return _run_flash(
         kern,
         (
             jnp.asarray(q_offset, jnp.int32).reshape(1),
-            jnp.asarray(row_lens, jnp.int32),
+            lens,
         ),
         [
             pl.BlockSpec(
                 (1,), lambda bh, tq, ts: (0,), memory_space=pltpu.SMEM
             ),
             pl.BlockSpec(
-                (1,), lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv,),
+                lens.shape, lambda bh, tq, ts: (0,),
                 memory_space=pltpu.SMEM,
             ),
         ],
@@ -329,12 +337,13 @@ def _ragged_pen(c0, row_len, tq, ts, tile_t, tile_s):
 
 
 def _flash_ragged_lse_kernel(
-    c0_ref, len_ref,
+    c0_ref, len_ref,  # len_ref: SMEM i32[B], indexed in-kernel
     q_ref, k_ref, v_ref,
     o_ref,
     lse_ref,  # [1, TILE_T * G, 1] out: per-row logsumexp (m + log l)
     m_scr, l_scr, acc_scr,
     *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+    n_kv: int,
 ):
     """The ragged forward, additionally emitting the logsumexp the
     backward's probability recompute needs. Identical o math to
@@ -342,7 +351,8 @@ def _flash_ragged_lse_kernel(
     fwd path to reproduce the primal's output exactly."""
     tq = pl.program_id(1)
     ts = pl.program_id(2)
-    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    row_len = len_ref[pl.program_id(0) // n_kv]
+    pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
     _softmax_fold(
         q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
         groups=groups, scale=scale, s_tiles=s_tiles,
@@ -374,6 +384,7 @@ def _flash_bwd_dq_kernel(
     dq_ref,  # [1, TqG, D] out
     dq_scr,  # f32[TqG, D] scratch
     *, groups: int, scale: float, s_tiles: int, tile_t: int, tile_s: int,
+    n_kv: int,
 ):
     tq = pl.program_id(1)
     ts = pl.program_id(2)  # innermost: S sweep, dq resident
@@ -382,7 +393,10 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    pen = _ragged_pen(
+        c0_ref[0], len_ref[pl.program_id(0) // n_kv], tq, ts,
+        tile_t, tile_s,
+    )
     p = _recompute_p(
         q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
     )
@@ -408,6 +422,7 @@ def _flash_bwd_dkv_kernel(
     dk_ref, dv_ref,  # [1, Sk, D] out
     dk_scr, dv_scr,  # f32[Sk, D] scratch
     *, groups: int, scale: float, t_tiles: int, tile_t: int, tile_s: int,
+    n_kv: int,
 ):
     ts = pl.program_id(1)
     tq = pl.program_id(2)  # innermost: T sweep, dk/dv resident
@@ -417,7 +432,10 @@ def _flash_bwd_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    pen = _ragged_pen(c0_ref[0], len_ref[0], tq, ts, tile_t, tile_s)
+    pen = _ragged_pen(
+        c0_ref[0], len_ref[pl.program_id(0) // n_kv], tq, ts,
+        tile_t, tile_s,
+    )
     p = _recompute_p(
         q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
     )
@@ -504,14 +522,13 @@ def _diff_fwd(interpret, q, k, v, q_offset, row_lens):
     lens = jnp.asarray(row_lens, jnp.int32)
     kern = functools.partial(
         _flash_ragged_lse_kernel, groups=G, scale=1.0 / float(D) ** 0.5,
-        s_tiles=s_tiles, tile_t=tile_t, tile_s=tile_s,
+        s_tiles=s_tiles, tile_t=tile_t, tile_s=tile_s, n_kv=n_kv,
     )
     smem1 = pl.BlockSpec(
         (1,), lambda bh, tq, ts: (0,), memory_space=pltpu.SMEM
     )
     smem_b = pl.BlockSpec(
-        (1,), lambda bh, tq, ts, n_kv=n_kv: (bh // n_kv,),
-        memory_space=pltpu.SMEM,
+        (B,), lambda bh, tq, ts: (0,), memory_space=pltpu.SMEM
     )
     qspec = pl.BlockSpec(
         (1, tile_t * G, D), lambda bh, tq, ts: (bh, tq, 0),
@@ -570,8 +587,7 @@ def _diff_bwd(interpret, res, do):
         (1,), lambda bh, a, b: (0,), memory_space=pltpu.SMEM
     )
     smem_b = pl.BlockSpec(
-        (1,), lambda bh, a, b, n_kv=n_kv: (bh // n_kv,),
-        memory_space=pltpu.SMEM,
+        (B,), lambda bh, a, b: (0,), memory_space=pltpu.SMEM
     )
 
     # dq: grid (bh, tq, ts), S innermost
@@ -590,7 +606,7 @@ def _diff_bwd(interpret, res, do):
     dqf = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, groups=G, scale=scale, s_tiles=s_tiles,
-            tile_t=tile_t, tile_s=tile_s,
+            tile_t=tile_t, tile_s=tile_s, n_kv=n_kv,
         ),
         grid=(B * n_kv, t_tiles, s_tiles),
         in_specs=[smem1, smem_b, q_at_tq, kv_at_ts, kv_at_ts, q_at_tq,
@@ -617,7 +633,7 @@ def _diff_bwd(interpret, res, do):
     dkf, dvf = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, groups=G, scale=scale, t_tiles=t_tiles,
-            tile_t=tile_t, tile_s=tile_s,
+            tile_t=tile_t, tile_s=tile_s, n_kv=n_kv,
         ),
         grid=(B * n_kv, s_tiles, t_tiles),
         in_specs=[smem1, smem_b, q_at_tq2, kv_at_ts2, kv_at_ts2,

@@ -1266,14 +1266,15 @@ def solve_auction(
             can_bid, prices[best_n] + (best_v - second_v) + eps, NEG
         )
 
-        # per-node highest bid wins; ties broken by lowest job index
-        bid_matrix = jnp.full((J, N), NEG, jnp.float32)
-        j_idx = jnp.arange(J, dtype=jnp.int32)
-        bid_matrix = bid_matrix.at[
-            j_idx, jnp.clip(best_n, 0, N - 1)
-        ].set(jnp.where(can_bid, bid, NEG))
-        win_bid = jnp.max(bid_matrix, axis=0)
-        winner = jnp.argmax(bid_matrix, axis=0).astype(jnp.int32)
+        # Per-node highest bid wins; ties broken by lowest job index.
+        # Scatter-free: the old [J, N] bid matrix built by .at[].set was
+        # a TPU-serialized scatter per iteration (the same lesson as the
+        # greedy accept, _dense_accept) — one broadcast-compare against
+        # the bid targets feeds both reductions instead.
+        mine = best_n[None, :] == n_iota[:, None]  # [N, J]
+        bids_on = jnp.where(mine & can_bid[None, :], bid[None, :], NEG)
+        win_bid = jnp.max(bids_on, axis=1)
+        winner = jnp.argmax(bids_on, axis=1).astype(jnp.int32)
         node_has_winner = win_bid > NEG * 0.5
 
         # Evict previous owners of re-won nodes. Non-events are routed

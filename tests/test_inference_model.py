@@ -323,21 +323,9 @@ class TestGemmaParity:
     """Gemma family: tied embeddings scaled by sqrt(H) into the residual
     stream, tanh-approx GeGLU, offset RMSNorm (gain = 1 + w), MQA."""
 
-    TINY_GEMMA = ModelConfig(
-        vocab_size=256,
-        hidden_size=64,
-        intermediate_size=128,
-        num_hidden_layers=2,
-        num_attention_heads=4,
-        num_key_value_heads=1,  # multi-query, like gemma-2b
-        rms_norm_eps=1e-6,
-        rope_theta=10000.0,
-        max_position_embeddings=512,
-        tie_word_embeddings=True,
-        hidden_act="gelu_pytorch_tanh",
-        scale_embeddings=True,
-        rmsnorm_offset=True,
-    )
+    # the exact config the demo/e2e path serves — parity must cover it,
+    # not a drift-prone test-local copy
+    TINY_GEMMA = PRESETS["tiny-gemma"]
 
     @pytest.fixture(scope="class")
     def hf_gemma(self):
