@@ -466,18 +466,21 @@ def solve_greedy(
 ) -> Assignment:
     """Parallel greedy with conflict resolution (policy ``jax-greedy``).
 
-    ``max_rounds`` bounds the pipelined loop globally; on the mega path it
-    is a PER-WINDOW budget (windows exit at their fixpoint far earlier —
-    ``Assignment.rounds`` is the summed diagnostic, and budget exhaustion
-    is signalled out-of-band so the repair/fill safety net still fires
-    exactly when progress was possible).
+    ``max_rounds`` bounds one pipelined main/repair loop invocation; on
+    the mega path it is a PER-WINDOW budget (windows exit at their
+    fixpoint far earlier). ``Assignment.rounds`` is the summed
+    diagnostic across invocations/windows, and budget exhaustion is
+    signalled out-of-band so the repair/fill safety net still fires
+    exactly when progress was possible.
 
-    ``seeded`` (STATIC; mega path only) compiles the incumbent-seeding +
-    preemption-repair machinery into the solve. It is semantically inert
-    on problems with no incumbents but costs ~0.2ms of skipped-branch
-    control flow at the headline shape, so backends pass
-    ``seeded=False`` when the request carries no ``current_node`` —
-    fresh solves trace none of it. Default True: the raw API stays
+    ``seeded`` (STATIC; every accel flavor) compiles the incumbent-
+    seeding + preemption-repair machinery into the solve: joint-fitting
+    incumbents hold their seats up front, and a repair loop unseats
+    lower-priority seats when they strand a higher-priority job. It is
+    semantically inert on problems with no incumbents but costs ~0.2ms
+    of skipped-branch control flow at the headline shape, so backends
+    pass ``seeded=False`` when the request carries no ``current_node``
+    — fresh solves trace none of it. Default True: the raw API stays
     stability-correct for incumbent problems without callers having to
     know the flag.
     """
@@ -788,11 +791,74 @@ def solve_greedy(
              jnp.any((assigned < 0) & jobs.valid)),
         )
 
+    # Seed joint-fitting incumbents as already placed (all accel
+    # flavors; `seeded` is static so fresh solves trace none of this).
+    # Stability rationale: without seeding, a re-solve makes incumbents
+    # RACE arrivals for their own homes — the mega path's cross-window
+    # serialization lost that race outright (measured 4.9% survivor
+    # moves under the 10% churn bench), and the pipelined path's
+    # home-bid-exemption racing still leaked ~0.2%. Seeding holds every
+    # joint-fitting incumbent's seat up front on both paths (measured
+    # 0.0% moves); the squat inversion it re-admits — a seated
+    # low-priority incumbent keeping capacity that leaves a
+    # higher-priority job unplaceable — is undone by the preemption
+    # repair below. A node whose incumbents no longer jointly fit
+    # releases ALL of them to re-bid.
+    if seeded:
+        n_iota_seed = jnp.arange(N, dtype=jnp.int32)
+        at_home = (jobs.current_node >= 0) & jobs.valid
+
+        def _seat_sums(_):
+            on_node = (
+                jobs.current_node[None, :] == n_iota_seed[:, None]
+            ) & at_home[None, :]
+            return (
+                jnp.sum(
+                    jnp.where(on_node, jobs.gpu_demand[None, :], 0.0),
+                    axis=1,
+                ),
+                jnp.sum(
+                    jnp.where(on_node, jobs.mem_demand[None, :], 0.0),
+                    axis=1,
+                ),
+            )
+
+        # cond-skipped when the request carried placements but all
+        # rows are -1: the two [N, J] seat-sum reduces cost ~0.15ms
+        # at the headline shape
+        used_g, used_m = lax.cond(
+            jnp.any(at_home),
+            _seat_sums,
+            lambda _: (
+                jnp.zeros((N,), jnp.float32),
+                jnp.zeros((N,), jnp.float32),
+            ),
+            0,
+        )
+        ok_node = (used_g <= gf_valid + _EPS) & (
+            used_m <= nodes.mem_free + _EPS
+        )
+        seated = at_home & ok_node[
+            jnp.clip(jobs.current_node, 0, N - 1)
+        ]
+        asg_init = jnp.where(seated, jobs.current_node, -1)
+        gf_seed = gf_valid - jnp.where(ok_node, used_g, 0.0)
+        mf_seed = nodes.mem_free - jnp.where(ok_node, used_m, 0.0)
+    else:
+        asg_init = jnp.full((J,), -1, jnp.int32)
+        gf_seed = gf_valid
+        mf_seed = nodes.mem_free
+
+    # One solve-to-fixpoint closure per accel flavor — the seeding and
+    # preemption repair drive whichever main loop is selected through
+    # the same interface: (assigned, gf_eff, mf) -> (assigned, gf, mf,
+    # rounds, capped). gf_eff arrives with invalid nodes folded to -1.
     if accel in ("mega", "mega-interpret", "mega-jnp"):
-        # Round-fusion main loop: every settlement round of every priority
-        # class runs inside ONE pallas_call (or its jnp twin), with the
-        # class's S window VMEM-resident — see pallas_kernels mega section
-        # for the algorithmic divergence from the pipelined-fence loop.
+        # Round-fusion main loop: every settlement round of every
+        # priority window runs inside ONE pallas_call (or its jnp twin),
+        # with the window's S slice VMEM-resident — see pallas_kernels'
+        # mega section for the algorithmic divergence from the
+        # pipelined-fence loop.
         from kubeinfer_tpu.solver import pallas_kernels as pk
 
         mega_fn = (
@@ -802,207 +868,152 @@ def solve_greedy(
                 pk.mega_solve_pallas, interpret=accel == "mega-interpret"
             )
         )
-        # Seed joint-fitting incumbents as already placed. Without this,
-        # cross-window serialization lets early windows consume late-
-        # window incumbents' homes before those incumbents ever bid —
-        # best-fit pressure actively TARGETS packed nodes — measured
-        # 4.9% survivor moves under the 10% churn bench vs the ~0.2%
-        # stability contract. Seeding reproduces the pipelined path's
-        # effective semantics (incumbents hold home before anyone else
-        # discovers the capacity, with the same documented inversion:
-        # a seated low-priority incumbent can squat capacity a higher-
-        # priority job wants — the preemption repair below undoes
-        # exactly that case). A node whose incumbents no longer jointly
-        # fit releases ALL of them to re-bid.
-        # Everything seeding-related lives under `if seeded:` so the
-        # "fresh solves trace none of it" claim is structural, not an
-        # inspection exercise.
-        if seeded:
-            n_iota_seed = jnp.arange(N, dtype=jnp.int32)
-            at_home = (jobs.current_node >= 0) & jobs.valid
 
-            def _seat_sums(_):
-                on_node = (
-                    jobs.current_node[None, :] == n_iota_seed[:, None]
-                ) & at_home[None, :]
-                return (
-                    jnp.sum(
-                        jnp.where(on_node, jobs.gpu_demand[None, :], 0.0),
-                        axis=1,
-                    ),
-                    jnp.sum(
-                        jnp.where(on_node, jobs.mem_demand[None, :], 0.0),
-                        axis=1,
-                    ),
-                )
-
-            # cond-skipped when the request carried placements but all
-            # rows are -1: the two [N, J] seat-sum reduces cost ~0.15ms
-            # at the headline shape
-            used_g, used_m = lax.cond(
-                jnp.any(at_home),
-                _seat_sums,
-                lambda _: (
-                    jnp.zeros((N,), jnp.float32),
-                    jnp.zeros((N,), jnp.float32),
-                ),
-                0,
-            )
-            ok_node = (used_g <= gf_valid + _EPS) & (
-                used_m <= nodes.mem_free + _EPS
-            )
-            seated = at_home & ok_node[
-                jnp.clip(jobs.current_node, 0, N - 1)
-            ]
-            asg_init = jnp.where(seated, jobs.current_node, -1)
-            gf_seed = gf_valid - jnp.where(ok_node, used_g, 0.0)
-            mf_seed = nodes.mem_free - jnp.where(ok_node, used_m, 0.0)
-        else:
-            asg_init = jnp.full((J,), -1, jnp.int32)
-            gf_seed = gf_valid
-            mf_seed = nodes.mem_free
-        assigned, gpu_free, mem_free, rounds, mega_capped = mega_fn(
-            S, jobs.gpu_demand, jobs.mem_demand, accept_key, rankf,
-            jobs.current_node, asg_init, jobs.valid, gf_seed, mf_seed,
-            v_g, v_m,
-            max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
-            q_max=q_max, node_idx_bits=node_idx_bits,
-        )
-
-        # The repair (like the seeding it repairs) exists only on
-        # seeded compiles — fresh solves trace none of it.
-        if seeded:
-            # Preemption repair: seeding holds incumbents' homes before any
-            # window bids, which re-admits the squat inversion — a seated
-            # low-priority incumbent keeping capacity that leaves a HIGHER-
-            # priority job unplaceable. (Jobs placed by the windows cannot
-            # cause this: a job unplaced at its own window's fixpoint found
-            # no node feasible, and later, lower-priority windows only
-            # shrink capacity further.) When that exact case occurs, unseat
-            # the lower-rank seats on the victim job's best reclaimable node
-            # and re-run the (now mostly-seeded, cheap) solve; the evictees
-            # re-bid like churn departures. Each iteration rescues the
-            # highest-priority stranded job — the accept key's (rank,
-            # demand-desc, index) order picks it. Termination is made
-            # monotone by the ``ever`` mask: only never-yet-unseated seats
-            # are victimizable, and every productive iteration marks >= 1
-            # new seat (any(can) requires nonzero freeable demand), so the
-            # loop runs at most #seated iterations — a job rescued back
-            # onto its own seat cannot be re-victimized (which doubles as
-            # repeat-churn protection for evictees), and unseating can
-            # never cycle. The it < J cap is a pure backstop. Exit property
-            # (fuzz-tested): the top-priority unplaced job cannot be fitted
-            # by unseating any single node's victimizable lower-rank seats.
-            def _preempt_repair(args):
-                assigned, gpu_free, mem_free, rounds, capped, it, _, ever = args
-                unpl = jobs.valid & (assigned < 0)
-                BIGK = jnp.int32(0x7FFFFFFF)
-                jkey = jnp.where(unpl, accept_key, BIGK)
-                j_star = jnp.argmin(jkey).astype(jnp.int32)
-                d_star = jobs.gpu_demand[j_star]
-                md_star = jobs.mem_demand[j_star]
-                r_star = rankf[j_star]
-                on_seat = seated & (assigned == jobs.current_node) & ~ever
-                victim = on_seat & (rankf > r_star)
-                vic_on = (
-                    jobs.current_node[None, :] == n_iota_seed[:, None]
-                ) & victim[None, :]
-                freed_g = jnp.sum(
-                    jnp.where(vic_on, jobs.gpu_demand[None, :], 0.0), axis=1
-                )
-                freed_m = jnp.sum(
-                    jnp.where(vic_on, jobs.mem_demand[None, :], 0.0), axis=1
-                )
-                can = (
-                    nodes.valid
-                    & (d_star <= gpu_free + freed_g + _EPS)
-                    & (md_star <= mem_free + freed_m + _EPS)
-                    & (freed_g + freed_m > 0.0)
-                )
-                scol = lax.dynamic_slice(
-                    S, (jnp.int32(0), j_star), (N, 1)
-                )[:, 0]
-                n_star = jnp.argmin(
-                    jnp.where(can, scol, jnp.float32(3.4e38))
-                ).astype(jnp.int32)
-
-                def _unseat_and_resolve(args):
-                    (
-                        assigned, gpu_free, mem_free, rounds, capped, it, _,
-                        ever,
-                    ) = args
-                    unseat = victim & (jobs.current_node == n_star)
-                    ever = ever | unseat
-                    assigned = jnp.where(unseat, -1, assigned)
-                    gpu_free = jnp.where(
-                        n_iota_seed == n_star, gpu_free + freed_g, gpu_free
-                    )
-                    mem_free = jnp.where(
-                        n_iota_seed == n_star, mem_free + freed_m, mem_free
-                    )
-                    assigned, gpu_free, mem_free, r2, capped2 = mega_fn(
-                        S, jobs.gpu_demand, jobs.mem_demand, accept_key,
-                        rankf, jobs.current_node, assigned, jobs.valid,
-                        jnp.where(nodes.valid, gpu_free, -1.0), mem_free,
-                        v_g, v_m,
-                        max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
-                        q_max=q_max, node_idx_bits=node_idx_bits,
-                    )
-                    # the re-solve can itself exhaust a window budget; the
-                    # repair/fill safety net must see that, not the stale
-                    # first-run flag
-                    return (
-                        assigned, gpu_free, mem_free, rounds + r2,
-                        capped | capped2, it + jnp.int32(1), jnp.bool_(True),
-                        ever,
-                    )
-
-                # No reclaimable node fits the TOP stranded job: stop (the
-                # progress flag ends the loop) rather than burn a window
-                # sweep for a guaranteed-identical assignment. Lower-ranked
-                # stranded jobs are not attempted past a stuck top job —
-                # they would demand even more reclaim.
-                return lax.cond(
-                    jnp.any(can), _unseat_and_resolve,
-                    lambda a: (*a[:6], jnp.bool_(False), a[7]),
-                    (assigned, gpu_free, mem_free, rounds, capped, it,
-                     jnp.bool_(True), ever),
-                )
-
-            def _repair_cond(args):
-                assigned, _, _, _, _, it, progress, ever = args
-                unpl_now = jobs.valid & (assigned < 0)
-                min_unpl_rank = jnp.min(
-                    jnp.where(unpl_now, rankf, RANK_INF)
-                )
-                squat = jnp.any(
-                    seated
-                    & (assigned == jobs.current_node)
-                    & ~ever
-                    & (rankf > min_unpl_rank)
-                )
-                # the #seated bound comes from the ever-mask monotonicity
-                # argument above; the explicit cap is a backstop, not a
-                # budget
-                return squat & progress & (it < jnp.int32(J))
-
-            (
-                assigned, gpu_free, mem_free, rounds, mega_capped, _, _, _
-            ) = lax.while_loop(
-                _repair_cond, _preempt_repair,
-                (assigned, gpu_free, mem_free, rounds, mega_capped,
-                 jnp.int32(0), jnp.bool_(True), jnp.zeros((J,), bool)),
+        def resolve_fn(a, gf_eff, mf_):
+            return mega_fn(
+                S, jobs.gpu_demand, jobs.mem_demand, accept_key, rankf,
+                jobs.current_node, a, jobs.valid, gf_eff, mf_,
+                v_g, v_m,
+                max_rounds=max_rounds, q_lo=q_lo, q_scale=q_scale,
+                q_max=q_max, node_idx_bits=node_idx_bits,
             )
     else:
-        assigned, gpu_free, mem_free, rounds, _ = run_rounds(
-            jnp.full((J,), -1, jnp.int32), gf_valid, nodes.mem_free,
-            jnp.int32(0), rankf, jnp.int32(max_rounds),
+
+        def resolve_fn(a, gf_eff, mf_):
+            # Pipelined rounds; budget exhaustion is the round counter
+            # hitting the cap (one global loop, unlike mega's
+            # summed-across-windows diagnostic)
+            a2, g2, m2, r2, _ = run_rounds(
+                a, gf_eff, mf_, jnp.int32(0), rankf,
+                jnp.int32(max_rounds),
+            )
+            return a2, g2, m2, r2, r2 >= max_rounds
+
+    assigned, gpu_free, mem_free, rounds, mega_capped = resolve_fn(
+        asg_init, gf_seed, mf_seed
+    )
+
+    # The repair (like the seeding it repairs) exists only on seeded
+    # compiles — fresh solves trace none of it.
+    if seeded:
+        # Preemption repair: seeding holds incumbents' homes before
+        # anyone bids, which re-admits the squat inversion — a seated
+        # low-priority incumbent keeping capacity that leaves a HIGHER-
+        # priority job unplaceable. (Jobs placed by the main loop cannot
+        # cause this: an unplaced job reached a fixpoint where no node
+        # was feasible, and capacities only shrink.) When that exact
+        # case occurs, unseat the lower-rank seats on the victim job's
+        # best reclaimable node and re-run the (now mostly-seeded,
+        # cheap) solve; the evictees re-bid like churn departures. Each
+        # iteration rescues the highest-priority stranded job — the
+        # accept key's (rank, demand-desc, index) order picks it.
+        # Termination is made monotone by the ``ever`` mask: only
+        # never-yet-unseated seats are victimizable, and every
+        # productive iteration marks >= 1 new seat (any(can) requires
+        # nonzero freeable demand), so the loop runs at most #seated
+        # iterations — a job rescued back onto its own seat cannot be
+        # re-victimized (which doubles as repeat-churn protection for
+        # evictees), and unseating can never cycle. The it < J cap is a
+        # pure backstop. Exit property (fuzz-tested): the top-priority
+        # unplaced job cannot be fitted by unseating any single node's
+        # victimizable lower-rank seats.
+        def _preempt_repair(args):
+            assigned, gpu_free, mem_free, rounds, capped, it, _, ever = args
+            unpl = jobs.valid & (assigned < 0)
+            BIGK = jnp.int32(0x7FFFFFFF)
+            jkey = jnp.where(unpl, accept_key, BIGK)
+            j_star = jnp.argmin(jkey).astype(jnp.int32)
+            d_star = jobs.gpu_demand[j_star]
+            md_star = jobs.mem_demand[j_star]
+            r_star = rankf[j_star]
+            on_seat = seated & (assigned == jobs.current_node) & ~ever
+            victim = on_seat & (rankf > r_star)
+            vic_on = (
+                jobs.current_node[None, :] == n_iota_seed[:, None]
+            ) & victim[None, :]
+            freed_g = jnp.sum(
+                jnp.where(vic_on, jobs.gpu_demand[None, :], 0.0), axis=1
+            )
+            freed_m = jnp.sum(
+                jnp.where(vic_on, jobs.mem_demand[None, :], 0.0), axis=1
+            )
+            can = (
+                nodes.valid
+                & (d_star <= gpu_free + freed_g + _EPS)
+                & (md_star <= mem_free + freed_m + _EPS)
+                & (freed_g + freed_m > 0.0)
+            )
+            scol = lax.dynamic_slice(
+                S, (jnp.int32(0), j_star), (N, 1)
+            )[:, 0]
+            n_star = jnp.argmin(
+                jnp.where(can, scol, jnp.float32(3.4e38))
+            ).astype(jnp.int32)
+
+            def _unseat_and_resolve(args):
+                (
+                    assigned, gpu_free, mem_free, rounds, capped, it, _,
+                    ever,
+                ) = args
+                unseat = victim & (jobs.current_node == n_star)
+                ever = ever | unseat
+                assigned = jnp.where(unseat, -1, assigned)
+                gpu_free = jnp.where(
+                    n_iota_seed == n_star, gpu_free + freed_g, gpu_free
+                )
+                mem_free = jnp.where(
+                    n_iota_seed == n_star, mem_free + freed_m, mem_free
+                )
+                assigned, gpu_free, mem_free, r2, capped2 = resolve_fn(
+                    assigned,
+                    jnp.where(nodes.valid, gpu_free, -1.0),
+                    mem_free,
+                )
+                # the re-solve can itself exhaust its round budget; the
+                # repair/fill safety net must see that, not the stale
+                # first-run flag
+                return (
+                    assigned, gpu_free, mem_free, rounds + r2,
+                    capped | capped2, it + jnp.int32(1), jnp.bool_(True),
+                    ever,
+                )
+
+            # No reclaimable node fits the TOP stranded job: stop (the
+            # progress flag ends the loop) rather than burn a sweep for
+            # a guaranteed-identical assignment. Lower-ranked stranded
+            # jobs are not attempted past a stuck top job — they would
+            # demand even more reclaim.
+            return lax.cond(
+                jnp.any(can), _unseat_and_resolve,
+                lambda a: (*a[:6], jnp.bool_(False), a[7]),
+                (assigned, gpu_free, mem_free, rounds, capped, it,
+                 jnp.bool_(True), ever),
+            )
+
+        def _repair_cond(args):
+            assigned, _, _, _, _, it, progress, ever = args
+            unpl_now = jobs.valid & (assigned < 0)
+            min_unpl_rank = jnp.min(
+                jnp.where(unpl_now, rankf, RANK_INF)
+            )
+            squat = jnp.any(
+                seated
+                & (assigned == jobs.current_node)
+                & ~ever
+                & (rankf > min_unpl_rank)
+            )
+            # the #seated bound comes from the ever-mask monotonicity
+            # argument above; the explicit cap is a backstop, not a
+            # budget
+            return squat & progress & (it < jnp.int32(J))
+
+        (
+            assigned, gpu_free, mem_free, rounds, mega_capped, _, _, _
+        ) = lax.while_loop(
+            _repair_cond, _preempt_repair,
+            (assigned, gpu_free, mem_free, rounds, mega_capped,
+             jnp.int32(0), jnp.bool_(True), jnp.zeros((J,), bool)),
         )
-        # Pipelined path: budget exhaustion is simply the round counter
-        # hitting the cap (one global loop). Mega reports it explicitly —
-        # its rounds are summed across windows, so comparing that sum to
-        # the per-window cap would fire spuriously at clean fixpoints.
-        mega_capped = rounds >= max_rounds
 
     # Repair + fill run only when some gang member is unplaced — the
     # exact trigger for an unwind. When every gang is complete, repair is

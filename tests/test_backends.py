@@ -220,3 +220,38 @@ class TestAuctionGuard:
         res = get_backend("jax-auction").solve(req)
         assert res.policy == SchedulerPolicy.JAX_GREEDY.value
         assert res.placed == 4  # capacity-bound, not auction-bound
+
+
+class TestSeededBackendPath:
+    def test_incumbents_survive_via_backend(self):
+        """The backend layer decides the solver's static `seeded` flag
+        from the request, and seeding must hold end to end (the
+        production churn path — reconciler ticks re-solve with
+        placements). The instance DISCRIMINATES: a higher-priority
+        arrival is cache-steered onto the lower-priority incumbent's
+        home node; unseeded, the arrival's window runs first, takes the
+        node, and the incumbent is displaced — hysteresis alone cannot
+        save it (verified: this assertion fails with seeded=False), so
+        a regression in the seeded plumbing turns the test red."""
+        cached = np.zeros((2, 4), bool)
+        cached[0, 1] = True  # arrival's model (slot 1) cached on node 0
+        req = SolveRequest(
+            # job 0: high-priority arrival, whole node; job 1: low-
+            # priority incumbent on node 0 (half the node). Model slot
+            # 0 means "no affinity", so the arrival uses slot 1.
+            job_gpu=np.array([8.0, 4.0], np.float32),
+            job_mem_gib=np.array([8.0, 4.0], np.float32),
+            job_priority=np.array([5.0, 0.0], np.float32),
+            job_model=np.array([1, 0], np.int32),
+            job_current_node=np.array([-1, 0], np.int32),
+            node_gpu_free=np.array([8.0, 8.0], np.float32),
+            node_mem_free_gib=np.array([64.0, 64.0], np.float32),
+            node_cached=cached,
+        )
+        res = get_backend("jax-greedy").solve(req)
+        assert res.placed == 2
+        # seeded: the incumbent keeps its home; the arrival no longer
+        # fits there (4 of 8 held) and lands on node 1 despite the
+        # cache miss
+        assert res.assignment[1] == 0
+        assert res.assignment[0] == 1
