@@ -18,6 +18,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.model import Params, attention, forward
+from kubeinfer_tpu.inference.sharding import forward_sequence_parallel
+
+
+def _nll_mean(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross entropy — the ONE copy of the loss math
+    every training flavor (dense, sharded, sequence-parallel) shares,
+    so they cannot silently diverge."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def _sgd(params: Params, grads: Params, lr: float) -> Params:
+    """Shared SGD update (params keep their dtype and placement)."""
+    return jax.tree.map(
+        lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype),
+        params, grads,
+    )
 
 
 def causal_lm_loss(
@@ -34,10 +52,7 @@ def causal_lm_loss(
     Pallas calls cannot partition under GSPMD.
     """
     logits, _ = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return _nll_mean(logits, tokens[:, 1:])
 
 
 @functools.partial(
@@ -51,11 +66,7 @@ def train_step(
     loss, grads = jax.value_and_grad(causal_lm_loss)(
         params, tokens, cfg, attn_fn
     )
-    new_params = jax.tree.map(
-        lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype),
-        params, grads,
-    )
-    return new_params, loss
+    return _sgd(params, grads, lr), loss
 
 
 def sharded_train_step(mesh: Mesh, cfg: ModelConfig):
@@ -79,5 +90,41 @@ def sharded_train_step(mesh: Mesh, cfg: ModelConfig):
         return new_params, jax.lax.with_sharding_constraint(
             loss, NamedSharding(mesh, P())
         )
+
+    return step
+
+
+def sp_causal_lm_loss(
+    params: Params, tokens: jax.Array, cfg: ModelConfig, mesh: Mesh
+) -> jax.Array:
+    """Causal-LM loss with the SEQUENCE axis sharded over the mesh's
+    ``sp`` axis — long-context training without any device ever holding
+    the full sequence (or anything [T, T]-sized). The ring-attention
+    forward differentiates end to end: ppermute transposes to ppermute
+    under AD and the online-softmax fold is plain jnp, so no custom
+    backward is needed (measured grad deltas vs the dense loss are
+    ~1e-8; the parity test guards at 5e-6 absolute to absorb
+    reduction-order noise across mesh shapes). tokens is [B, T+1] with
+    T divisible by the sp axis size.
+    """
+    logits = forward_sequence_parallel(params, tokens[:, :-1], cfg, mesh)
+    return _nll_mean(logits, tokens[:, 1:])
+
+
+def sp_train_step(mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3):
+    """Jitted SGD step over the sequence-parallel loss.
+
+    Returns ``step(params, tokens) -> (params, loss)``. Complements
+    sharded_train_step (tensor/data parallel): this one scales the
+    SEQUENCE dimension over ICI — the two compose at the mesh level the
+    same way the serving stack's SP x TP route does.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(params: Params, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(sp_causal_lm_loss)(
+            params, tokens, cfg, mesh
+        )
+        return _sgd(params, grads, lr), loss
 
     return step
