@@ -220,6 +220,15 @@ class Store:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._data_dir / "snapshot.json")
+        # The rename's dirent update must hit disk before the journal is
+        # truncated: otherwise a crash can persist the truncate but lose the
+        # rename, dropping journaled records and regressing rv (the CAS /
+        # lease-stealing continuity this store exists to protect).
+        dfd = os.open(self._data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._journal_f.close()
         self._journal_f = open(
             self._data_dir / "journal.jsonl", "w", encoding="utf-8"

@@ -363,9 +363,12 @@ def _flash_ragged_lse_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def _recompute_p(q, k, pen, lse_col, groups, scale):
+def _recompute_p(q, k, pen, lse_col, row_len, groups, scale):
     """[TqG, Sk] softmax probabilities from (q, k, L): exp(qk*scale +
-    pen - L). Exact — L is the forward's converged logsumexp."""
+    pen - L). Exact — L is the forward's converged logsumexp. A fully
+    masked row (row_len == 0) is degenerate: s and L both saturate at
+    -1e30 in f32 so exp(s - L) would be 1 per slot, not 0 — gate to 0 so
+    dq/dk/dv for such rows vanish like the dense path's."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -373,7 +376,7 @@ def _recompute_p(q, k, pen, lse_col, groups, scale):
     s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
         tq * groups, sk
     )
-    return jnp.exp(s - lse_col)
+    return jnp.where(row_len > 0, jnp.exp(s - lse_col), 0.0)
 
 
 def _flash_bwd_dq_kernel(
@@ -393,12 +396,10 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    pen = _ragged_pen(
-        c0_ref[0], len_ref[pl.program_id(0) // n_kv], tq, ts,
-        tile_t, tile_s,
-    )
+    row_len = len_ref[pl.program_id(0) // n_kv]
+    pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
     p = _recompute_p(
-        q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
+        q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
     )
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -432,12 +433,10 @@ def _flash_bwd_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    pen = _ragged_pen(
-        c0_ref[0], len_ref[pl.program_id(0) // n_kv], tq, ts,
-        tile_t, tile_s,
-    )
+    row_len = len_ref[pl.program_id(0) // n_kv]
+    pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
     p = _recompute_p(
-        q_ref[0], k_ref[0], pen, lse_ref[0], groups, scale
+        q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
     )
     # dv += p^T dO; the folded (t, g) rows make the GQA group reduction
     # implicit in the row contraction

@@ -660,8 +660,8 @@ def mega_window(N: int, J: int) -> int | None:
         for w in range(wmax, 0, -128):
             if J % w == 0:
                 return w
-    if J % 64 == 0 and fit >= J:
-        return J  # the one sub-128 bucket (J=64): a single window
+    if J == 64 and fit >= J:
+        return J  # the one sub-128 bucket: a single 64-wide window
     return None  # N too large for any window: pipelined fallback
 
 

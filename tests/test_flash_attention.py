@@ -336,6 +336,31 @@ class TestFlashBackward:
         gd, gf = self._grads(1, 32, 2, 2, 16, [32], dtype=jnp.bfloat16)
         self._assert_close(gd, gf, 5e-2)
 
+    def test_grad_zero_for_empty_rows(self):
+        """row_len == 0 rows must contribute NO gradient. The naive
+        recompute would give p == 1 per slot there (s and lse both
+        saturate at -1e30 in f32, so exp(s - lse) == 1); _recompute_p
+        gates those rows to 0. Note this deliberately diverges from the
+        dense path's dv, which leaks a uniform 1/S spread into v for
+        fully-masked rows (softmax-of-constant artifact) — zero is the
+        right semantics for padding rows. Non-empty rows still match
+        dense."""
+        gd, gf = self._grads(2, 32, 4, 4, 16, [20, 0])
+        for got, name in zip(gf, "qkv"):
+            np.testing.assert_array_equal(
+                np.asarray(got, np.float32)[1],
+                np.zeros_like(np.asarray(got, np.float32)[1]),
+                err_msg=f"d{name} row_len=0",
+            )
+        # row 0 (live) still matches dense; dense dv row 1 carries the
+        # 1/S leak so only q/k rows and the live dv row are compared
+        for want, got, name in zip(gd, gf, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32)[0],
+                np.asarray(want, np.float32)[0],
+                atol=2e-4, rtol=5e-3, err_msg=f"d{name} live row",
+            )
+
     def test_primal_value_unchanged(self):
         """The custom_vjp primal must equal the plain ragged kernel
         bit-for-bit (custom_vjp contract: fwd reproduces the primal)."""
