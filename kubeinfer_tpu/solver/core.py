@@ -70,9 +70,16 @@ RANK_INF = jnp.float32(1e9)
 # Auction tie/war handling (see the commentary in solve_auction): values
 # within _TIE_TOL of a job's best count as tied for hash tie-breaking;
 # _STALE_ITERS bounds how long the loop may run without placing a new
-# job before delegating the stragglers to the completeness fill.
+# job before delegating the stragglers to the completeness fill. 16 is a
+# measured choice (r5, v5e, bench 1kx1k: 64 -> 131 iterations / 16 -> 37,
+# ~23.7us each in the fused kernel, auction-placed 995 -> 991 with the
+# fill completing to 1000 either way): iterations past a 16-stale window
+# are price-war plateau involving <1% of jobs, and the war's end state is
+# the fill's output by construction (see the stagnation-exit notes in
+# solve_auction), so the extra patience bought ~2.2ms of device time and
+# 4 placements whose J*eps bound the fill forfeits anyway.
 _TIE_TOL = 1e-5
-_STALE_ITERS = 64
+_STALE_ITERS = 16
 
 
 @dataclass(frozen=True)
@@ -1166,99 +1173,74 @@ def _gang_repair(p: Problem, assigned: jax.Array):
     return assigned, nodes.gpu_free - used_gpu, nodes.mem_free - used_mem
 
 
-@functools.partial(jax.jit, static_argnames=("max_iters", "accel"))
-def solve_auction(
-    p: Problem,
-    weights: ScoreWeights = ScoreWeights(),
-    eps: float = 0.01,
-    max_iters: int = 512,
-    accel: str = "auto",
-) -> Assignment:
-    """Auction assignment (policy ``jax-auction``): one replica per node.
-
-    Feasible means the whole remaining node capacity satisfies the demand;
-    each node hosts at most one replica. Within-eps-optimal total cost for
-    the jobs it places (standard auction guarantee: J*eps of optimal).
-
-    Priority does NOT influence auction outcomes (a per-job constant in the
-    benefit cancels out of the bid increments): when preemption matters,
-    use ``jax-greedy`` (priority-gated rounds) or ``native-greedy``
-    (priority-sorted serial pass).
-
-    Capacity freed by the post-solve gang repair is re-offered in the
-    SAME solve (r2 verdict item 7 closed the former leave-idle
-    relaxation): a fenced greedy fill runs over the repaired capacities
-    with only unplaced NON-gang jobs eligible — a restricted sub-problem
-    through solve_greedy itself, so the non-gang fixpoint guarantee
-    ("no feasible non-gang job left unplaced") holds for the final
-    capacities here exactly as it does on the greedy path.
-    """
-    jobs, nodes = p.jobs, p.nodes
-    J = jobs.valid.shape[0]
-    N = nodes.valid.shape[0]
-    static_cost = _static_cost_t(p, weights).T  # auction math is job-major
-    feas = (
-        (jobs.gpu_demand[:, None] <= nodes.gpu_free[None, :] + _EPS)
-        & (jobs.mem_demand[:, None] <= nodes.mem_free[None, :] + _EPS)
-        & nodes.valid[None, :]
-        & jobs.valid[:, None]
-    )
-    # benefit: higher is better; strictly bounded so -INF marks infeasible
-    inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
-    inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
-    fit_cost = _fit_cost(
-        nodes.gpu_free, nodes.mem_free, p, weights, inv_gpu_cap, inv_mem_cap
-    )
-    benefit = jnp.where(feas, -(static_cost + fit_cost), -INFEASIBLE)
-    NEG = -INFEASIBLE
-
-    # Price-war handling (r3 item 4) — three measured mechanisms; ref for
-    # the fixed-eps war they fix: BENCH_r03 cfg_1kx1k_auction_placed=995.
-    # (1) Selection tie-breaking: a parallel (Jacobi) auction on a
-    # homogeneous fleet is degenerate — identical benefit rows make every
-    # job's argmax the same first index, ONE bid wins per iteration, and a
-    # 1000-identical-jobs instance needs ~1000 iterations (the r3 995/1000
-    # under-placement was exactly the max_iters cutoff of that war). A
-    # deterministic per-(job, node) hash picks among values within
-    # _TIE_TOL of the job's best instead, spreading one iteration's bids
-    # across ~63% of the tied tier (measured: 256-identical converges in
-    # 6 iterations vs the 1000+ cap). Tied bids are all true argmaxes, so
-    # the J*eps bound only degrades by the tolerance: J*(eps+_TIE_TOL).
-    # (2) Stagnation exit (below): model-pocket overflow — 25 jobs whose
-    # model is cached on 20 nodes — is a genuine +eps-per-bid war (each
-    # overflow job must push the whole pocket's prices past the cache
-    # gap, ~20*5.0/eps bids, measured as a 500+-iteration plateau of 5
-    # roving jobs on the r3 bench instance). The war's own end state is
-    # "overflow jobs land on non-hit nodes", which is exactly what the
-    # completeness fill produces, so the loop exits after _STALE_ITERS
-    # iterations without a net placement and hands the stragglers to the
-    # fill instead of burning the budget on price flattening.
-    # Two rejected alternatives, tried and measured: Bertsekas eps-scaling
-    # (coarse-to-fine phases, prices kept, assignment reset) collapses
-    # under a parallel Jacobi auction — the phase restart leaves a single
-    # roving unassigned job serially re-flattening the coarse phase's
-    # price spread at +eps per iteration (599 iters on the 256-identical
-    # instance whose single-phase solve takes 6); and tier-jump margins
-    # (bid against the best value below the tied tier) break the eviction
-    # signal, because tiers are per-job — a job that overpays its tier in
-    # one jump prices out a second job whose only hit node it took
-    # (measured: 2x the optimal Hungarian cost on the oracle test).
+def _auction_tiebreak(J: int, N: int) -> jax.Array:
+    """Deterministic per-(job, node) i31 hash for selection tie-breaking
+    (see the price-war notes in solve_auction). Computed once per solve
+    and shared verbatim by both loop implementations — identical integer
+    ops make the twin/kernel choice invisible to outcomes."""
     _n2 = lax.broadcasted_iota(jnp.int32, (J, N), 1)
     _j2 = lax.broadcasted_iota(jnp.int32, (J, N), 0)
     _h2 = _j2 * jnp.int32(-1640531527) + _n2 * jnp.int32(40503)
     _h2 = _h2 ^ (_h2 >> 13)
     _h2 = _h2 * jnp.int32(-1274126529)
-    tiebreak = (_h2 ^ (_h2 >> 16)) & jnp.int32(0x7FFFFFFF)
+    return (_h2 ^ (_h2 >> 16)) & jnp.int32(0x7FFFFFFF)
+
+
+def _auction_accel(accel: str, J: int, N: int) -> str:
+    """Pick the auction loop implementation: '' = jnp while_loop twin,
+    'pallas'/'interpret' = the one-launch kernel (pk.auction_solve).
+
+    Same vocabulary as _resolve_accel so callers don't need a second
+    knob: any Pallas-flavored greedy accel opts the auction into its
+    fused loop too; 'jnp'/'mega-jnp' keep the GSPMD-safe twin. Mosaic
+    wants J%8 sublanes / N%128 lanes and the VMEM-resident benefit
+    field must fit (auction_fits)."""
+    from kubeinfer_tpu.solver import pallas_kernels as pk
+
+    aligned = J % 8 == 0 and N % 128 == 0 and pk.auction_fits(J, N)
+    if accel == "auto":
+        if aligned and jax.default_backend() == "tpu":
+            return "pallas"
+        return ""
+    if accel in ("pallas", "mega", "interpret", "mega-interpret"):
+        # An explicit Pallas request on an ineligible shape fails loudly
+        # (mirrors _resolve_accel): a silent twin fallback would make
+        # kernel parity tests vacuous and mislabel bench timings.
+        if not aligned:
+            raise ValueError(
+                f"accel={accel!r} requested but the auction kernel needs "
+                f"J%8==0, N%128==0 and a VMEM-resident [J,N] field; got "
+                f"J={J} N={N} (fits={pk.auction_fits(J, N)}). Use "
+                "accel='jnp' or 'auto'."
+            )
+        return "interpret" if accel in ("interpret", "mega-interpret") \
+            else "pallas"
+    return ""
+
+
+def _auction_loop_jnp(
+    benefit: jax.Array,  # f32[J, N]; -INFEASIBLE marks infeasible
+    tiebreak: jax.Array,  # i32[J, N] from _auction_tiebreak
+    valid: jax.Array,  # bool[J]
+    eps: jax.Array,
+    max_iters: int,
+) -> tuple[jax.Array, jax.Array]:
+    """The Jacobi auction loop under XLA — the jnp twin of
+    ``pk.auction_solve`` (bit-identical, see its docstring) and the code
+    path for GSPMD-sharded solves and unaligned shapes. Returns
+    (assigned i32[J], iters i32)."""
+    J, N = benefit.shape
+    NEG = -INFEASIBLE
     n_iota = jnp.arange(N, dtype=jnp.int32)
 
     def cond(state):
         assigned, owner, prices, it, progress, pending_best, stale = state
-        pending = jnp.any((assigned < 0) & jobs.valid)
+        pending = jnp.any((assigned < 0) & valid)
         return progress & pending & (it < max_iters) & (stale < _STALE_ITERS)
 
     def body(state):
         assigned, owner, prices, it, _, pending_best, stale = state
-        unassigned = (assigned < 0) & jobs.valid
+        unassigned = (assigned < 0) & valid
         value = jnp.where(
             unassigned[:, None], benefit - prices[None, :], NEG
         )
@@ -1310,7 +1292,7 @@ def solve_auction(
         assigned = jnp.where(won_node >= 0, won_node, assigned)
         # Stagnation tracking: a war iteration evicts as many as it
         # places, so the pending count is the monotone progress signal
-        n_pending = jnp.sum(((assigned < 0) & jobs.valid).astype(jnp.int32))
+        n_pending = jnp.sum(((assigned < 0) & valid).astype(jnp.int32))
         improved = n_pending < pending_best
         return (
             assigned, owner, prices, it + 1, jnp.any(can_bid),
@@ -1327,9 +1309,101 @@ def solve_auction(
         jnp.int32(J + 1),
         jnp.int32(0),
     )
-    assigned, owner, prices, iters, _, _, _ = lax.while_loop(
-        cond, body, init
+    assigned, _, _, iters, _, _, _ = lax.while_loop(cond, body, init)
+    return assigned, iters
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters", "accel"))
+def solve_auction(
+    p: Problem,
+    weights: ScoreWeights = ScoreWeights(),
+    eps: float = 0.01,
+    max_iters: int = 512,
+    accel: str = "auto",
+) -> Assignment:
+    """Auction assignment (policy ``jax-auction``): one replica per node.
+
+    Feasible means the whole remaining node capacity satisfies the demand;
+    each node hosts at most one replica. Within-eps-optimal total cost for
+    the jobs it places (standard auction guarantee: J*eps of optimal).
+
+    Priority does NOT influence auction outcomes (a per-job constant in the
+    benefit cancels out of the bid increments): when preemption matters,
+    use ``jax-greedy`` (priority-gated rounds) or ``native-greedy``
+    (priority-sorted serial pass).
+
+    Capacity freed by the post-solve gang repair is re-offered in the
+    SAME solve (r2 verdict item 7 closed the former leave-idle
+    relaxation): a fenced greedy fill runs over the repaired capacities
+    with only unplaced NON-gang jobs eligible — a restricted sub-problem
+    through solve_greedy itself, so the non-gang fixpoint guarantee
+    ("no feasible non-gang job left unplaced") holds for the final
+    capacities here exactly as it does on the greedy path.
+    """
+    jobs, nodes = p.jobs, p.nodes
+    J = jobs.valid.shape[0]
+    N = nodes.valid.shape[0]
+    static_cost = _static_cost_t(p, weights).T  # auction math is job-major
+    feas = (
+        (jobs.gpu_demand[:, None] <= nodes.gpu_free[None, :] + _EPS)
+        & (jobs.mem_demand[:, None] <= nodes.mem_free[None, :] + _EPS)
+        & nodes.valid[None, :]
+        & jobs.valid[:, None]
     )
+    # benefit: higher is better; strictly bounded so -INF marks infeasible
+    inv_gpu_cap = 1.0 / jnp.maximum(nodes.gpu_capacity, 1.0)
+    inv_mem_cap = 1.0 / jnp.maximum(nodes.mem_capacity, 1.0)
+    fit_cost = _fit_cost(
+        nodes.gpu_free, nodes.mem_free, p, weights, inv_gpu_cap, inv_mem_cap
+    )
+    benefit = jnp.where(feas, -(static_cost + fit_cost), -INFEASIBLE)
+
+    # Price-war handling (r3 item 4) — three measured mechanisms; ref for
+    # the fixed-eps war they fix: BENCH_r03 cfg_1kx1k_auction_placed=995.
+    # (1) Selection tie-breaking: a parallel (Jacobi) auction on a
+    # homogeneous fleet is degenerate — identical benefit rows make every
+    # job's argmax the same first index, ONE bid wins per iteration, and a
+    # 1000-identical-jobs instance needs ~1000 iterations (the r3 995/1000
+    # under-placement was exactly the max_iters cutoff of that war). A
+    # deterministic per-(job, node) hash picks among values within
+    # _TIE_TOL of the job's best instead, spreading one iteration's bids
+    # across ~63% of the tied tier (measured: 256-identical converges in
+    # 6 iterations vs the 1000+ cap). Tied bids are all true argmaxes, so
+    # the J*eps bound only degrades by the tolerance: J*(eps+_TIE_TOL).
+    # (2) Stagnation exit (below): model-pocket overflow — 25 jobs whose
+    # model is cached on 20 nodes — is a genuine +eps-per-bid war (each
+    # overflow job must push the whole pocket's prices past the cache
+    # gap, ~20*5.0/eps bids, measured as a 500+-iteration plateau of 5
+    # roving jobs on the r3 bench instance). The war's own end state is
+    # "overflow jobs land on non-hit nodes", which is exactly what the
+    # completeness fill produces, so the loop exits after _STALE_ITERS
+    # iterations without a net placement and hands the stragglers to the
+    # fill instead of burning the budget on price flattening.
+    # Two rejected alternatives, tried and measured: Bertsekas eps-scaling
+    # (coarse-to-fine phases, prices kept, assignment reset) collapses
+    # under a parallel Jacobi auction — the phase restart leaves a single
+    # roving unassigned job serially re-flattening the coarse phase's
+    # price spread at +eps per iteration (599 iters on the 256-identical
+    # instance whose single-phase solve takes 6); and tier-jump margins
+    # (bid against the best value below the tied tier) break the eviction
+    # signal, because tiers are per-job — a job that overpays its tier in
+    # one jump prices out a second job whose only hit node it took
+    # (measured: 2x the optimal Hungarian cost on the oracle test).
+    tiebreak = _auction_tiebreak(J, N)
+    mode = _auction_accel(accel, J, N)
+    if mode:
+        from kubeinfer_tpu.solver import pallas_kernels as pk
+
+        assigned, iters = pk.auction_solve(
+            benefit, tiebreak, jobs.valid, eps,
+            max_iters=max_iters, stale_iters=_STALE_ITERS,
+            tie_tol=_TIE_TOL, neg=-float(INFEASIBLE),
+            interpret=(mode == "interpret"),
+        )
+    else:
+        assigned, iters = _auction_loop_jnp(
+            benefit, tiebreak, jobs.valid, eps, max_iters
+        )
 
     # The fill runs whenever ANY valid job is unplaced — either a gang
     # member (whose unwind frees capacity the fill re-offers) or a plain

@@ -1130,3 +1130,189 @@ def accept_flags_pallas(
         win_key.reshape(N, 1),
     )
     return acc[0] != 0
+
+
+# --- auction: the whole Jacobi loop in one launch ------------------------
+#
+# solve_auction's lax.while_loop costs ~40us of per-iteration launch /
+# serialization overhead under XLA (measured r4: 4.78ms for 118 iterations
+# at 1kx1k — the same dispatch-bound profile the greedy round loop had
+# before the mega kernel). Here the loop runs INSIDE one pallas_call with
+# the [J, N] benefit field VMEM-resident; every per-iteration product
+# ([J, N] value/bid masks) lives and dies in VMEM. The jnp twin is
+# core._auction_loop_jnp — bit-identical by construction: every float is
+# either copied through a selection (max/min/where picks) or produced by
+# the exact expression the twin uses (bid = price + (best_v - second_v)
+# + eps), and all tie-breaks resolve to lowest-index in both.
+#
+# Scatter-free by necessity (Mosaic has no scatter): the twin's two
+# .at[].set scatters (evictions, won-node writeback) become
+# broadcast-compare + lane reductions over [J, N] — the same trade the
+# accept-verdict kernels made (module docstring).
+
+# Per-iteration live set: benefit + tiebreak inputs plus ~4 [J, N]
+# selection temporaries Mosaic keeps concurrently (value, near/tb,
+# bids_on, the evict/won compares). 12x input bytes is a conservative
+# ceiling under the raised 100MB scoped limit.
+_AUCTION_TEMPS = 12
+
+
+def auction_fits(J: int, N: int) -> bool:
+    """True when the one-launch auction's VMEM working set fits."""
+    return _AUCTION_TEMPS * J * N * 4 <= _MEGA_VMEM_LIMIT
+
+
+def _auction_kernel(
+    eps_ref,  # SMEM f32 (1,1): runtime bid increment
+    benefit_ref,  # VMEM f32 [J, N]; -1e9 marks infeasible
+    tiebreak_ref,  # VMEM i32 [J, N] hash (core.py computes it once)
+    valid_ref,  # VMEM i32 [J, 1]
+    asg_ref,  # out VMEM i32 [J, 1]
+    iters_ref,  # out SMEM i32 (1,1)
+    *,
+    max_iters: int,
+    stale_iters: int,
+    tie_tol: float,
+    neg: float,
+):
+    J, N = benefit_ref.shape
+    benefit = benefit_ref[...]
+    tiebreak = tiebreak_ref[...]
+    valid = valid_ref[...] != 0  # [J, 1]
+    eps = eps_ref[0, 0]
+    NEG = jnp.float32(neg)
+    n2 = jax.lax.broadcasted_iota(jnp.int32, (J, N), 1)
+    j2 = jax.lax.broadcasted_iota(jnp.int32, (J, N), 0)
+
+    def cond(state):
+        asg, owner, prices, it, progress, pending_best, stale = state
+        pending = jnp.any((asg < 0) & valid)
+        return (
+            (progress != 0)
+            & pending
+            & (it < max_iters)
+            & (stale < stale_iters)
+        )
+
+    def body(state):
+        asg, owner, prices, it, _, pending_best, stale = state
+        unassigned = (asg < 0) & valid  # [J, 1]
+        value = jnp.where(unassigned, benefit - prices, NEG)  # [J, N]
+        best_v = jnp.max(value, axis=1, keepdims=True)  # [J, 1]
+        near = value >= best_v - jnp.float32(tie_tol)
+        tb = jnp.where(near, tiebreak, -1)
+        tb_max = jnp.max(tb, axis=1, keepdims=True)
+        # argmax(tb, axis=1) with lowest-index ties, scatter-free
+        best_n = jnp.min(
+            jnp.where(tb == tb_max, n2, N), axis=1, keepdims=True
+        )
+        at_best = n2 == best_n  # [J, N]: job j's single bid target
+        second_v = jnp.max(
+            jnp.where(at_best, NEG, value), axis=1, keepdims=True
+        )
+        can_bid = unassigned & (best_v > NEG * 0.5)  # [J, 1]
+        price_at_best = jnp.max(
+            jnp.where(at_best, jnp.broadcast_to(prices, (J, N)), NEG),
+            axis=1, keepdims=True,
+        )  # gather prices[best_n] as a lane selection
+        bid = jnp.where(
+            can_bid, price_at_best + (best_v - second_v) + eps, NEG
+        )  # [J, 1]
+
+        bids_on = jnp.where(at_best & can_bid, bid, NEG)  # [J, N]
+        win_bid = jnp.max(bids_on, axis=0, keepdims=True)  # [1, N]
+        winner = jnp.min(
+            jnp.where(bids_on == win_bid, j2, J), axis=0, keepdims=True
+        )  # [1, N]: highest bid, lowest job index on float ties
+        node_has_winner = win_bid > NEG * 0.5  # [1, N]
+
+        # twin's eviction scatter: job j is evicted iff some re-won node
+        # listed it as owner
+        evict = (
+            jnp.max(
+                jnp.where(node_has_winner & (owner == j2), 1, 0),
+                axis=1, keepdims=True,
+            )
+            > 0
+        )  # [J, 1]
+        asg = jnp.where(evict, -1, asg)
+        owner = jnp.where(node_has_winner, winner, owner)
+        prices = jnp.where(node_has_winner, win_bid, prices)
+        # twin's won-node scatter: each winning job finds its (unique)
+        # node by lane reduction
+        won_node = jnp.min(
+            jnp.where(node_has_winner & (winner == j2), n2, N),
+            axis=1, keepdims=True,
+        )  # [J, 1]
+        asg = jnp.where(won_node < N, won_node, asg)
+        n_pending = jnp.sum(((asg < 0) & valid).astype(jnp.int32))
+        improved = n_pending < pending_best
+        return (
+            asg, owner, prices,
+            it + jnp.int32(1),
+            jnp.any(can_bid).astype(jnp.int32),
+            jnp.minimum(n_pending, pending_best),
+            jnp.where(improved, jnp.int32(0), stale + jnp.int32(1)),
+        )
+
+    init = (
+        jnp.full((J, 1), -1, jnp.int32),
+        jnp.full((1, N), -1, jnp.int32),
+        jnp.zeros((1, N), jnp.float32),
+        jnp.int32(0),
+        jnp.int32(1),
+        jnp.int32(J + 1),
+        jnp.int32(0),
+    )
+    asg, _, _, it, _, _, _ = jax.lax.while_loop(cond, body, init)
+    asg_ref[...] = asg
+    iters_ref[0, 0] = it
+
+
+def auction_solve(
+    benefit: jax.Array,  # f32[J, N]
+    tiebreak: jax.Array,  # i32[J, N]
+    valid: jax.Array,  # bool[J]
+    eps: jax.Array,  # f32 scalar (traced — a tunable request field)
+    *,
+    max_iters: int,
+    stale_iters: int,
+    tie_tol: float,
+    neg: float,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One-launch auction loop. Returns (assigned i32[J], iters i32).
+
+    Twin: ``core._auction_loop_jnp`` (bit-identical; parity test in
+    tests/test_solver_core.py). Callers gate on ``auction_fits`` and the
+    J%8 / N%128 Mosaic layout requirements (core._auction_accel)."""
+    J, N = benefit.shape
+    kern = functools.partial(
+        _auction_kernel,
+        max_iters=max_iters,
+        stale_iters=stale_iters,
+        tie_tol=tie_tol,
+        neg=neg,
+    )
+    full = pl.BlockSpec((J, N), lambda: (0, 0), memory_space=pltpu.VMEM)
+    col = pl.BlockSpec((J, 1), lambda: (0, 0), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 1), lambda: (0, 0), memory_space=pltpu.SMEM)
+    asg, iters = pl.pallas_call(
+        kern,
+        in_specs=[smem, full, full, col],
+        out_specs=[col, smem],
+        out_shape=[
+            jax.ShapeDtypeStruct((J, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_MEGA_VMEM_LIMIT
+        ),
+    )(
+        jnp.asarray(eps, jnp.float32).reshape(1, 1),
+        benefit,
+        tiebreak,
+        valid.astype(jnp.int32).reshape(J, 1),
+    )
+    return asg[:, 0], iters[0, 0]

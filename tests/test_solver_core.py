@@ -1019,3 +1019,84 @@ class TestAuctionGangFill:
                     jobs[j].mem_gib <= mem_left + EPS
                 )
                 assert not fits.any(), (seed, int(j))
+
+
+class TestAuctionFusedParity:
+    """The one-launch auction kernel (pk.auction_solve, interpret mode)
+    must be BIT-identical to its jnp twin (core._auction_loop_jnp) —
+    the CLAUDE.md kernel/twin invariant. Every arithmetic float in the
+    kernel is either a selection of a twin-computed value or the same
+    expression in the same order, so exact equality is the contract,
+    not a tolerance."""
+
+    def _rand_instance(self, seed, J, N):
+        from kubeinfer_tpu.solver.core import INFEASIBLE, _auction_tiebreak
+
+        rng = np.random.default_rng(seed)
+        benefit = rng.normal(0.0, 3.0, (J, N)).astype(np.float32)
+        infeas = rng.random((J, N)) < 0.25
+        benefit = jnp.asarray(
+            np.where(infeas, -float(INFEASIBLE), benefit), jnp.float32
+        )
+        valid = jnp.asarray(rng.random(J) < 0.9)
+        return benefit, _auction_tiebreak(J, N), valid
+
+    @pytest.mark.parametrize(
+        "seed,J,N", [(0, 96, 128), (1, 128, 128), (2, 256, 384), (3, 8, 128)]
+    )
+    def test_kernel_matches_twin_bitwise(self, seed, J, N):
+        from kubeinfer_tpu.solver import pallas_kernels as pk
+        from kubeinfer_tpu.solver.core import (
+            _STALE_ITERS,
+            _TIE_TOL,
+            INFEASIBLE,
+            _auction_loop_jnp,
+        )
+
+        benefit, tiebreak, valid = self._rand_instance(seed, J, N)
+        eps = jnp.float32(0.01)
+        want_asg, want_it = _auction_loop_jnp(
+            benefit, tiebreak, valid, eps, 512
+        )
+        got_asg, got_it = pk.auction_solve(
+            benefit, tiebreak, valid, eps,
+            max_iters=512, stale_iters=_STALE_ITERS, tie_tol=_TIE_TOL,
+            neg=-float(INFEASIBLE), interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_asg), np.asarray(want_asg)
+        )
+        assert int(got_it) == int(want_it)
+
+    def test_solve_auction_accel_interpret_matches_jnp(self):
+        """End-to-end: solve_auction under accel='interpret' (fused loop
+        via the interpreter + pallas fill kernels) places the same jobs
+        as accel='jnp' on an aligned instance."""
+        from kubeinfer_tpu.solver.problem import encode_problem_arrays
+
+        rng = np.random.default_rng(5)
+        J, N = 128, 128
+        p = encode_problem_arrays(
+            job_gpu=rng.integers(1, 8, J).astype(np.float32),
+            job_mem_gib=rng.integers(1, 32, J).astype(np.float32),
+            job_model=rng.integers(0, 16, J).astype(np.int32),
+            node_gpu_free=np.full(N, 16.0, np.float32),
+            node_mem_free_gib=np.full(N, 64.0, np.float32),
+            node_cached=(rng.random((N, 16)) < 0.2),
+        )
+        a_jnp = solve_auction(p, accel="jnp")
+        a_int = solve_auction(p, accel="interpret")
+        np.testing.assert_array_equal(
+            np.asarray(a_int.node), np.asarray(a_jnp.node)
+        )
+        assert int(a_int.placed) == int(a_jnp.placed)
+
+    def test_explicit_pallas_on_ineligible_shape_fails_loudly(self):
+        """An explicit Pallas-flavored accel must not silently fall back
+        to the twin (that would make kernel parity tests vacuous)."""
+        from kubeinfer_tpu.solver.core import _auction_accel
+
+        with pytest.raises(ValueError, match="auction kernel needs"):
+            _auction_accel("interpret", 100, 64)  # J%8 ok? 100%8=4 -> no
+        assert _auction_accel("jnp", 100, 64) == ""
+        assert _auction_accel("auto", 100, 64) == ""
