@@ -5,16 +5,22 @@ PY ?= python
 # Tests run on a forced virtual CPU mesh (tests/conftest.py); bench runs on
 # whatever JAX backend is live (real TPU chip if present).
 
-.PHONY: all native test test-e2e bench bench-quick bench-full lint \
+.PHONY: all native test test-fast test-e2e bench bench-quick bench-full lint \
         run-manager run-agent docker-build clean
 
-all: native test
+all: native test-fast
 
 native:
 	$(MAKE) -C native
 
 test: native
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_process_e2e.py
+
+# Developer default: skip the explicitly slow-marked compile-heaviest
+# tests (pyproject markers; ~6min of jit compiles). CI and pre-round
+# gates run the full `test` tier.
+test-fast: native
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_process_e2e.py -m "not slow"
 
 test-e2e: native
 	$(PY) -m pytest tests/test_process_e2e.py tests/test_e2e_slice.py -q -x

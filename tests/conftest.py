@@ -60,3 +60,32 @@ def scrubbed_pythonpath() -> str:
 
     rest = scrub_axon_pythonpath()
     return REPO_ROOT + (os.pathsep + rest if rest else "")
+
+
+# --- suite tiering (r4 verdict item 7) -------------------------------------
+# Component markers are derived from the module name so they can never
+# drift from the file layout; `slow` is opted into per-test where the
+# compile cost lives (the suite is compile-bound, not run-bound, so
+# slowness is a property of individual jit programs, not components).
+# `make test-fast` runs `-m "not slow"`; CI's full tier runs everything.
+
+_COMPONENT_BY_PREFIX = (
+    (("test_solver", "test_problem", "test_backends", "test_sharded",
+      "test_distributed", "test_multiprocess"),
+     "solver"),
+    (("test_inference", "test_flash", "test_sampling", "test_speculative"),
+     "inference"),
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        for prefixes, marker in _COMPONENT_BY_PREFIX:
+            if mod.startswith(prefixes):
+                item.add_marker(getattr(pytest.mark, marker))
+                break
+        else:
+            item.add_marker(pytest.mark.controlplane)

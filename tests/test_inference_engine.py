@@ -27,6 +27,7 @@ def ref_greedy(params, prompt: list[int], steps: int) -> list[int]:
 
 
 class TestEngine:
+    @pytest.mark.slow
     def test_greedy_matches_uncached_reference(self):
         params = init_params(TINY, jax.random.PRNGKey(4))
         engine = Engine(params, TINY)
@@ -53,6 +54,7 @@ class TestEngine:
         assert out.lengths[0] == 3
         assert (out.tokens[0, 3:] == eos).all()  # post-EOS padded with EOS
 
+    @pytest.mark.slow
     def test_chunked_prefill_multi_chunk_exact(self, monkeypatch):
         """Prefill split across several chunks must equal the one-shot
         forward (patch the chunk small so test-sized prompts span >1)."""
@@ -73,6 +75,7 @@ class TestEngine:
         assert out.tokens.shape == (1, 1)
         assert out.tokens[0].tolist() == ref_greedy(params, [5, 6, 7], 1)
 
+    @pytest.mark.slow
     def test_cache_narrower_than_prompt_bucket(self):
         # regression: max_cache_len=100 with a 70-token prompt bucketed to
         # 128 used to build a negative-width mask; capacity checks must be
@@ -104,6 +107,7 @@ class TestEngine:
 
 
 class TestHFGenerateParity:
+    @pytest.mark.slow
     def test_greedy_matches_transformers_generate(self):
         torch = pytest.importorskip("torch")
         transformers = pytest.importorskip("transformers")

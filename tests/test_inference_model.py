@@ -56,6 +56,7 @@ class TestForwardBasics:
 
 
 class TestKVCacheDecode:
+    @pytest.mark.slow
     def test_incremental_decode_matches_full_forward(self):
         """Prefill + per-token cached decode == one full forward."""
         cfg = TINY

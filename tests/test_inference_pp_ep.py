@@ -46,6 +46,7 @@ class TestPipelineParallel:
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_pp_matches_dense_for_qwen2_and_mixtral(self):
         # pp must work for every family (specs derive from the layer
         # template, not a hardcoded llama key list — r2 review finding)

@@ -100,6 +100,7 @@ class TestEndpoints:
 
 
 class TestRuntimeLauncherIntegration:
+    @pytest.mark.slow
     def test_runtime_kind_native_spawns_real_server(self, tmp_path, monkeypatch):
         """RUNTIME_KIND=native + the standard env contract boots the
         native engine as a subprocess through the unchanged RuntimeServer

@@ -38,6 +38,7 @@ def _prompt(n, seed=0):
 
 
 class TestSPPrefill:
+    @pytest.mark.slow
     def test_kv_handoff_matches_chunked_prefill(self):
         """The gathered SP caches and last-position logits must agree
         with the single-device chunked prefill (same model, same prompt)
@@ -192,6 +193,7 @@ class TestSPTimesTP:
     are full/tp, the KV cache comes back sharded over sp AND tp, and
     outputs match the single-device engine."""
 
+    @pytest.mark.slow
     def test_tp_sharded_handoff_matches_chunked_prefill(self):
         from kubeinfer_tpu.inference.sharding import shard_params
 
@@ -256,6 +258,7 @@ class TestSPTimesTP:
                 jnp.asarray([16]), odd, mesh,
             )
 
+    @pytest.mark.slow
     def test_tied_embeddings_full_vocab_logits(self):
         """Tied-embedding models keep full-vocab logits on every device
         (the embed table is replicated; there is no lm_head to vocab-

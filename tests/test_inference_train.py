@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 from kubeinfer_tpu.inference import PRESETS, init_params
 from kubeinfer_tpu.inference.sharding import make_inference_mesh, shard_params
 from kubeinfer_tpu.inference.train import (
@@ -72,6 +74,7 @@ class TestSequenceParallelTraining:
     differentiates (ppermute transposes under AD), so the sp mesh axis
     shards the sequence for TRAINING, not just serving."""
 
+    @pytest.mark.slow
     def test_sp_grads_match_dense(self):
         from kubeinfer_tpu.inference.sharding import make_inference_mesh
         from kubeinfer_tpu.inference.train import (

@@ -15,12 +15,15 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 WORKER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "testdata", "distributed_worker.py",
 )
 
 
+@pytest.mark.slow
 def test_two_process_group_runs_sharded_solve():
     from tests.conftest import scrubbed_pythonpath
 

@@ -89,6 +89,7 @@ class TestSamplingBehavior:
             np.asarray(got), np.asarray(jnp.argmax(x, -1))
         )
 
+    @pytest.mark.slow
     def test_samples_stay_inside_top_k(self):
         x = _logits(6, B=1, V=16)
         top3 = set(np.argsort(np.asarray(x[0]))[-3:].tolist())
