@@ -84,42 +84,39 @@ def _warped_dist(logits, temperature, top_k, top_p):
     the tempered, top-k/top-p-filtered logits (engine.gumbel_sample's
     gumbel-argmax samples exactly this). Both p (target) and q (draft)
     must use the SAME warping or the acceptance ratio is against the
-    wrong measure."""
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    filtered = filter_logits(scaled, top_k, top_p)
+    wrong measure.
+
+    The warp knobs are PER-ROW [B] vectors (r4 verdict item 5: sampled
+    requests with different temperatures/filters batch into one draft
+    group); logits may be [B, V] or [B, W, V]."""
+    lead = (logits.shape[0],) + (1,) * (logits.ndim - 2)
+    scaled = logits / jnp.maximum(temperature, 1e-6).reshape(lead + (1,))
+    filtered = filter_logits(
+        scaled, top_k.reshape(lead), top_p.reshape(lead)
+    )
     return jax.nn.softmax(filtered, axis=-1), filtered
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
-                     "prefill_chunk", "sampled"),
-)
-def _spec_generate_jit(
-    params: Params,
-    dparams: Params,
-    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
-    prompt_len: jax.Array,  # i32[B]
-    cfg: ModelConfig,
-    dcfg: ModelConfig,
-    max_new: int,
-    cache_len: int,
-    k: int,
-    prefill_chunk: int,
-    eos_id: jax.Array,  # i32 (negative = never stop)
-    sampled: bool = False,
-    temperature: jax.Array | float = 0.0,
-    top_k: jax.Array | int = 0,
-    top_p: jax.Array | float = 1.0,
-    rng_key: jax.Array | None = None,
+def _decode_mask(cache_len: int, offsets, q_width: int):
+    """bool[B, q_width, cache_len]: row b's query at global position
+    offsets[b]+i attends cache slots <= that position (stale slots
+    beyond the valid frontier are excluded by the bound)."""
+    q_pos = offsets[:, None] + jnp.arange(q_width)[None, :]  # [B, W]
+    return jnp.arange(cache_len)[None, None, :] <= q_pos[:, :, None]
+
+
+def _prefill_state(
+    params, dparams, prompt, prompt_len, cfg, dcfg, max_new, cache_len,
+    k, prefill_chunk, eos_id, sampled, temperature, top_k, top_p, rng_key,
 ):
+    """Prefill target+draft and build the round-loop carry (round 0
+    emits the target's first token, exactly like engine.py's ``first``).
+    Shared by the bulk scan path (_spec_generate_jit) and the
+    incremental group path (_spec_group_prefill) — an identical state0
+    plus the shared _one_round is what makes the two paths
+    bit-identical (tests pin it)."""
     B, T = prompt.shape
     dtype = params["norm"].dtype
-    temperature = jnp.asarray(temperature, jnp.float32)
-    top_k = jnp.asarray(top_k, jnp.int32)
-    top_p = jnp.asarray(top_p, jnp.float32)
-    if rng_key is None:
-        rng_key = jax.random.PRNGKey(0)
     tcaches = make_caches(cfg, B, cache_len, dtype)
     dcaches = make_caches(dcfg, B, cache_len, dparams["norm"].dtype)
 
@@ -137,14 +134,38 @@ def _spec_generate_jit(
     else:
         first = _greedy(t_logits)  # [B] the target's first token
 
-    cache_pos = jnp.arange(cache_len)
+    # every round may emit up to k+1 tokens past max_new - 1 priors
+    written0 = jnp.zeros((B, max_new + k + 1), jnp.int32)
+    written0 = written0.at[:, 0].set(first)
+    counts0 = jnp.ones((B,), jnp.int32)
+    done0 = (first == eos_id) & (eos_id >= 0)
+    # `first` occupies the cache slot AT each row's prompt length; the
+    # token before it is the prompt's last real token
+    offsets0 = prompt_len
+    prev0 = jnp.take_along_axis(
+        prompt, jnp.clip(prompt_len - 1, 0, T - 1)[:, None], axis=1
+    )[:, 0]
+    return (
+        tcaches, dcaches, prev0, first, offsets0, written0, counts0, done0,
+        jnp.zeros((B,), jnp.int32), jnp.int32(0), rng_key,
+    )
+
+
+def _one_round(
+    params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+    temperature, top_k, top_p, carry,
+):
+    """One speculation round over the loop carry: k draft proposals, one
+    target verify forward, acceptance, buffer write. Module-level so the
+    bulk scan and the incremental group path run the SAME trace."""
+    (tcaches, dcaches, prev, cur, offsets, written, counts, done,
+     accepted, rounds, key) = carry
+    B = prev.shape[0]
+    cache_len = tcaches[0][0].shape[1]
+    key, k_draft, k_acc, k_res = jax.random.split(key, 4)
 
     def decode_mask(offsets, q_width):
-        """bool[B, q_width, cache_len]: row b's query at global position
-        offsets[b]+i attends cache slots <= that position (stale slots
-        beyond the valid frontier are excluded by the bound)."""
-        q_pos = offsets[:, None] + jnp.arange(q_width)[None, :]  # [B, W]
-        return cache_pos[None, None, :] <= q_pos[:, :, None]
+        return _decode_mask(cache_len, offsets, q_width)
 
     def draft_propose(dcaches, prev, cur, offsets, key):
         """k draft steps (greedy argmax, or sampled from the draft's
@@ -205,144 +226,170 @@ def _spec_generate_jit(
             qdists = jnp.zeros((B, k, cfg.vocab_size), jnp.float32)
         return dcaches, drafts, qdists
 
-    def round_step(carry, _):
-        (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-         accepted, rounds, key) = carry
-        key, k_draft, k_acc, k_res = jax.random.split(key, 4)
-
-        dcaches, drafts, qdists = draft_propose(
-            dcaches, prev, cur, offsets, k_draft
-        )
-        window = jnp.concatenate([cur[:, None], drafts], axis=1)
-        t_logits, tcaches = forward(
-            params, window, cfg,
-            positions=offsets[:, None] + jnp.arange(k + 1)[None, :],
-            attn_mask=decode_mask(offsets, k + 1),
-            kv_caches=tcaches,
-            cache_offset=offsets,
-        )
-
-        emit_idx = jnp.arange(k + 1)[None, :]
-        if sampled:
-            # Rejection sampling: accept x_i ~ q_i with prob
-            # min(1, p_i(x_i)/q_i(x_i)) — u*q < p avoids the division
-            # (q(x) > 0 whenever x was sampled from q). The first
-            # rejected position resamples from norm(max(p - q, 0));
-            # padding q with a zero row makes the fully-accepted bonus
-            # position the same formula (residual = p_{k+1}).
-            pdists, _ = _warped_dist(t_logits, temperature, top_k, top_p)
-            px = jnp.take_along_axis(
-                pdists[:, :k], drafts[..., None], axis=-1
-            )[..., 0]
-            qx = jnp.take_along_axis(
-                qdists, drafts[..., None], axis=-1
-            )[..., 0]
-            u = jax.random.uniform(k_acc, (B, k))
-            accept_tok = u * qx < px
-            prefix_ok = jnp.cumprod(accept_tok.astype(jnp.int32), axis=1)
-            m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
-            q_pad = jnp.concatenate(
-                [qdists, jnp.zeros_like(qdists[:, :1])], axis=1
-            )
-            p_m = jnp.take_along_axis(
-                pdists, m[:, None, None], axis=1
-            )[:, 0]
-            q_m = jnp.take_along_axis(
-                q_pad, m[:, None, None], axis=1
-            )[:, 0]
-            resid = jnp.maximum(p_m - q_m, 0.0)
-            s = jnp.sum(resid, axis=-1, keepdims=True)
-            # all-zero residual (p identical to q under the filters):
-            # every token was acceptable, resample from p directly
-            dist = jnp.where(s > 0, resid / jnp.maximum(s, 1e-38), p_m)
-            logdist = jnp.where(dist > 0, jnp.log(dist), -jnp.inf)
-            repl = jax.random.categorical(k_res, logdist, axis=-1).astype(
-                jnp.int32
-            )
-            emitted = jnp.where(
-                emit_idx < m[:, None],
-                jnp.pad(drafts, ((0, 0), (0, 1))),
-                repl[:, None],
-            )
-        else:
-            targets = _greedy(t_logits)
-            # longest prefix of drafts the target agrees with
-            agree = drafts == targets[:, :k]
-            prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-            m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
-
-            # emitted tokens this round: drafts[:, :m] then targets[:, m]
-            # — a static [B, k+1] row whose slots past m duplicate
-            # targets[:, m] (harmless: n_emit bounds what counts)
-            emitted = jnp.where(
-                emit_idx < m[:, None],
-                jnp.pad(drafts, ((0, 0), (0, 1))),
-                jnp.take_along_axis(targets, m[:, None], axis=1),
-            )
-        is_eos = (emitted == eos_id) & (eos_id >= 0)
-        first_eos = jnp.where(
-            is_eos.any(axis=1),
-            jnp.argmax(is_eos, axis=1) + 1,
-            k + 1,
-        )
-        n_emit = jnp.minimum(m + 1, first_eos)
-        n_emit = jnp.where(done, 0, n_emit)
-        hit_eos = is_eos.any(axis=1) & (first_eos <= m + 1)
-
-        # write the static row at each row's count; slots past n_emit are
-        # garbage that the NEXT round's write (which starts inside them)
-        # overwrites, and the host slices to counts at the end. Done rows
-        # write too (at their frozen count, i.e. beyond their final
-        # length) — masking the write would cost a select over the whole
-        # buffer for nothing.
-        written = jax.vmap(
-            lambda buf, row, c: jax.lax.dynamic_update_slice(buf, row, (c,))
-        )(written, emitted, counts)
-
-        counts = counts + n_emit
-        # diagnostics: accepted draft tokens (the speedup) and rounds
-        # with any active row — tests pin sustained acceptance on these
-        accepted = accepted + jnp.maximum(n_emit - 1, 0)
-        rounds = rounds + jnp.any(~done).astype(jnp.int32)
-        done = done | hit_eos | (counts >= max_new)
-        # next round continues from the last VALID token; prev is the
-        # token one position behind it (the draft's repair window)
-        last_idx = jnp.clip(n_emit - 1, 0, k)
-        new_cur = jnp.take_along_axis(
-            emitted, last_idx[:, None], axis=1
-        )[:, 0]
-        prev_idx = jnp.clip(n_emit - 2, 0, k)
-        new_prev = jnp.where(
-            n_emit >= 2,
-            jnp.take_along_axis(emitted, prev_idx[:, None], axis=1)[:, 0],
-            cur,
-        )
-        prev = jnp.where(n_emit > 0, new_prev, prev)
-        cur = jnp.where(n_emit > 0, new_cur, cur)
-        offsets = offsets + n_emit
-        return (
-            (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-             accepted, rounds, key),
-            (),
-        )
-
-    # round 0 state: the target's first token is emitted before any
-    # speculation (it came from prefill), exactly like engine.py's
-    # ``first``
-    written0 = jnp.zeros((B, max_new + k + 1), jnp.int32)
-    written0 = written0.at[:, 0].set(first)
-    counts0 = jnp.ones((B,), jnp.int32)
-    done0 = (first == eos_id) & (eos_id >= 0)
-    # `first` occupies the cache slot AT each row's prompt length; the
-    # token before it is the prompt's last real token
-    offsets0 = prompt_len
-    prev0 = jnp.take_along_axis(
-        prompt, jnp.clip(prompt_len - 1, 0, T - 1)[:, None], axis=1
-    )[:, 0]
-    state0 = (
-        tcaches, dcaches, prev0, first, offsets0, written0, counts0, done0,
-        jnp.zeros((B,), jnp.int32), jnp.int32(0), rng_key,
+    dcaches, drafts, qdists = draft_propose(
+        dcaches, prev, cur, offsets, k_draft
     )
+    window = jnp.concatenate([cur[:, None], drafts], axis=1)
+    t_logits, tcaches = forward(
+        params, window, cfg,
+        positions=offsets[:, None] + jnp.arange(k + 1)[None, :],
+        attn_mask=decode_mask(offsets, k + 1),
+        kv_caches=tcaches,
+        cache_offset=offsets,
+    )
+
+    emit_idx = jnp.arange(k + 1)[None, :]
+    if sampled:
+        # Rejection sampling: accept x_i ~ q_i with prob
+        # min(1, p_i(x_i)/q_i(x_i)) — u*q < p avoids the division
+        # (q(x) > 0 whenever x was sampled from q). The first
+        # rejected position resamples from norm(max(p - q, 0));
+        # padding q with a zero row makes the fully-accepted bonus
+        # position the same formula (residual = p_{k+1}).
+        pdists, _ = _warped_dist(t_logits, temperature, top_k, top_p)
+        px = jnp.take_along_axis(
+            pdists[:, :k], drafts[..., None], axis=-1
+        )[..., 0]
+        qx = jnp.take_along_axis(
+            qdists, drafts[..., None], axis=-1
+        )[..., 0]
+        u = jax.random.uniform(k_acc, (B, k))
+        accept_tok = u * qx < px
+        prefix_ok = jnp.cumprod(accept_tok.astype(jnp.int32), axis=1)
+        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
+        q_pad = jnp.concatenate(
+            [qdists, jnp.zeros_like(qdists[:, :1])], axis=1
+        )
+        p_m = jnp.take_along_axis(
+            pdists, m[:, None, None], axis=1
+        )[:, 0]
+        q_m = jnp.take_along_axis(
+            q_pad, m[:, None, None], axis=1
+        )[:, 0]
+        resid = jnp.maximum(p_m - q_m, 0.0)
+        s = jnp.sum(resid, axis=-1, keepdims=True)
+        # all-zero residual (p identical to q under the filters):
+        # every token was acceptable, resample from p directly
+        dist = jnp.where(s > 0, resid / jnp.maximum(s, 1e-38), p_m)
+        logdist = jnp.where(dist > 0, jnp.log(dist), -jnp.inf)
+        repl = jax.random.categorical(k_res, logdist, axis=-1).astype(
+            jnp.int32
+        )
+        emitted = jnp.where(
+            emit_idx < m[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            repl[:, None],
+        )
+    else:
+        targets = _greedy(t_logits)
+        # longest prefix of drafts the target agrees with
+        agree = drafts == targets[:, :k]
+        prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
+
+        # emitted tokens this round: drafts[:, :m] then targets[:, m]
+        # — a static [B, k+1] row whose slots past m duplicate
+        # targets[:, m] (harmless: n_emit bounds what counts)
+        emitted = jnp.where(
+            emit_idx < m[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            jnp.take_along_axis(targets, m[:, None], axis=1),
+        )
+    is_eos = (emitted == eos_id) & (eos_id >= 0)
+    first_eos = jnp.where(
+        is_eos.any(axis=1),
+        jnp.argmax(is_eos, axis=1) + 1,
+        k + 1,
+    )
+    n_emit = jnp.minimum(m + 1, first_eos)
+    n_emit = jnp.where(done, 0, n_emit)
+    hit_eos = is_eos.any(axis=1) & (first_eos <= m + 1)
+
+    # write the static row at each row's count; slots past n_emit are
+    # garbage that the NEXT round's write (which starts inside them)
+    # overwrites, and the host slices to counts at the end. Done rows
+    # write too (at their frozen count, i.e. beyond their final
+    # length) — masking the write would cost a select over the whole
+    # buffer for nothing.
+    written = jax.vmap(
+        lambda buf, row, c: jax.lax.dynamic_update_slice(buf, row, (c,))
+    )(written, emitted, counts)
+
+    counts = counts + n_emit
+    # diagnostics: accepted draft tokens (the speedup) and rounds
+    # with any active row — tests pin sustained acceptance on these
+    accepted = accepted + jnp.maximum(n_emit - 1, 0)
+    rounds = rounds + jnp.any(~done).astype(jnp.int32)
+    done = done | hit_eos | (counts >= max_new)
+    # next round continues from the last VALID token; prev is the
+    # token one position behind it (the draft's repair window)
+    last_idx = jnp.clip(n_emit - 1, 0, k)
+    new_cur = jnp.take_along_axis(
+        emitted, last_idx[:, None], axis=1
+    )[:, 0]
+    prev_idx = jnp.clip(n_emit - 2, 0, k)
+    new_prev = jnp.where(
+        n_emit >= 2,
+        jnp.take_along_axis(emitted, prev_idx[:, None], axis=1)[:, 0],
+        cur,
+    )
+    prev = jnp.where(n_emit > 0, new_prev, prev)
+    cur = jnp.where(n_emit > 0, new_cur, cur)
+    offsets = offsets + n_emit
+    return (tcaches, dcaches, prev, cur, offsets, written, counts, done,
+            accepted, rounds, key)
+
+
+def _vector_warp(B, temperature, top_k, top_p):
+    """Broadcast scalar-or-[B] warp knobs to per-row [B] vectors."""
+    return (
+        jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,)),
+        jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,)),
+        jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,)),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
+                     "prefill_chunk", "sampled"),
+)
+def _spec_generate_jit(
+    params: Params,
+    dparams: Params,
+    prompt: jax.Array,  # i32[B, T_bucket] left-aligned, 0-padded
+    prompt_len: jax.Array,  # i32[B]
+    cfg: ModelConfig,
+    dcfg: ModelConfig,
+    max_new: int,
+    cache_len: int,
+    k: int,
+    prefill_chunk: int,
+    eos_id: jax.Array,  # i32 (negative = never stop)
+    sampled: bool = False,
+    temperature: jax.Array | float = 0.0,
+    top_k: jax.Array | int = 0,
+    top_p: jax.Array | float = 1.0,
+    rng_key: jax.Array | None = None,
+):
+    """Bulk path: prefill + all rounds in one scan (fastest for a solo
+    generate). The incremental group path runs the same _prefill_state /
+    _one_round pair one round per call (bit-identical outputs)."""
+    B = prompt.shape[0]
+    temperature, top_k, top_p = _vector_warp(B, temperature, top_k, top_p)
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    state0 = _prefill_state(
+        params, dparams, prompt, prompt_len, cfg, dcfg, max_new,
+        cache_len, k, prefill_chunk, eos_id, sampled, temperature,
+        top_k, top_p, rng_key,
+    )
+
+    def round_step(carry, _):
+        return _one_round(
+            params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+            temperature, top_k, top_p, carry,
+        ), ()
 
     if max_new > 1:
         state, _ = jax.lax.scan(round_step, state0, None, length=max_new - 1)
@@ -350,6 +397,39 @@ def _spec_generate_jit(
         state = state0
     written, counts, accepted, rounds = state[5], state[6], state[8], state[9]
     return written, jnp.minimum(counts, max_new), accepted, rounds
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
+                     "prefill_chunk", "sampled"),
+)
+def _spec_group_prefill(
+    params, dparams, prompt, prompt_len, cfg, dcfg, max_new, cache_len,
+    k, prefill_chunk, eos_id, sampled, temperature, top_k, top_p, rng_key,
+):
+    return _prefill_state(
+        params, dparams, prompt, prompt_len, cfg, dcfg, max_new,
+        cache_len, k, prefill_chunk, eos_id, sampled, temperature,
+        top_k, top_p, rng_key,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "max_new", "k", "sampled"),
+    donate_argnums=(2,),
+)
+def _spec_group_round(
+    params, dparams, carry, cfg, dcfg, max_new, k, sampled, eos_id,
+    temperature, top_k, top_p,
+):
+    """One speculation round for a live group (carry donated: the KV
+    caches are rewritten in place across rounds)."""
+    return _one_round(
+        params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+        temperature, top_k, top_p, carry,
+    )
 
 
 @dataclass
@@ -424,16 +504,112 @@ class SpeculativeEngine:
             top_p=jnp.float32(top_p),
             rng_key=jax.random.PRNGKey(seed),
         )
+        return self._assemble(toks, counts, accepted, rounds,
+                              max_new_tokens, eos_id)
+
+    def _assemble(self, written, counts, accepted, rounds, max_new,
+                  eos_id) -> GenerationResult:
+        """Shared output contract for the bulk and incremental paths:
+        clamp counts to max_new, slice each row to its count, EOS-pad
+        beyond it (engine.py's contract), record diagnostics. One copy —
+        the bulk/incremental bit-identity the tests pin depends on both
+        paths assembling identically."""
         # diagnostics for tests/telemetry: accepted draft tokens per row
         # and speculation rounds executed (the cost side of the trade)
         self.last_stats = {
             "accepted_drafts": np.asarray(accepted),
             "rounds": int(rounds),
         }
-        toks = np.asarray(toks)[:, :max_new_tokens]
-        counts = np.asarray(counts)
-        # EOS-pad beyond each row's true length (engine.py's contract)
-        out = np.full((B, max_new_tokens), eos_id, np.int32)
+        toks = np.asarray(written)[:, :max_new]
+        counts = np.minimum(np.asarray(counts), max_new)
+        B = toks.shape[0]
+        out = np.full((B, max_new), eos_id, np.int32)
         for b in range(B):
             out[b, : counts[b]] = toks[b, : counts[b]]
         return GenerationResult(out, counts)
+
+    # -- incremental group API (r4 verdict item 5) ------------------------
+    #
+    # The bulk generate() blocks for the whole scan, which is right for a
+    # solo request but wrong inside the continuous batcher: a draft group
+    # must interleave with busy decode slots. start/step/finish split the
+    # SAME computation at round granularity — _prefill_state and
+    # _one_round are shared with the scan, so the incremental outputs are
+    # bit-identical to generate()'s (tests pin it). One round costs k
+    # draft forwards + one (k+1)-wide target forward, so slot requests
+    # see a bounded ~2-step latency bubble per round, not a whole
+    # generation.
+
+    def start_group(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 32,
+        eos_id: int = -1,
+        temperatures: list[float] | float = 0.0,
+        top_ks: list[int] | int = 0,
+        top_ps: list[float] | float = 1.0,
+        seed: int = 0,
+    ) -> "SpecGroup":
+        """Prefill a draft group. Warp knobs are per-row (a sampled group
+        may mix temperatures/filters); the MODE (greedy vs sampled) is
+        group-wide — the batcher drains homogeneous groups. Sampled rows
+        draw from one group key stream seeded by ``seed`` (the head
+        request's): each row's marginal distribution is exactly the
+        target's (the rejection correction is per-row), but token-level
+        reproducibility is per-group, not per-member."""
+        B = len(prompts)
+        temperature, top_k, top_p = _vector_warp(
+            B, np.asarray(temperatures, np.float32),
+            np.asarray(top_ks, np.int32), np.asarray(top_ps, np.float32),
+        )
+        sampled = bool(np.any(np.asarray(temperatures) > 0))
+        padded, lens, cache_len = prepare_prompts(
+            prompts, max_new_tokens, self.max_cache_len, slack=self.k + 1
+        )
+        state = _spec_group_prefill(
+            self.params, self.draft_params,
+            jnp.asarray(padded), jnp.asarray(lens),
+            self.cfg, self.draft_cfg,
+            max_new_tokens, cache_len, self.k, PREFILL_CHUNK,
+            jnp.int32(eos_id), sampled, temperature, top_k, top_p,
+            jax.random.PRNGKey(seed),
+        )
+        return SpecGroup(
+            state=state, max_new=max_new_tokens, eos_id=eos_id,
+            sampled=sampled, temperature=temperature, top_k=top_k,
+            top_p=top_p,
+        )
+
+    def step_group(self, g: "SpecGroup") -> bool:
+        """Advance one speculation round; True when every row is done
+        (or the round budget — max_new-1, the scan length — is spent)."""
+        if g.rounds_run >= g.max_new - 1:
+            return True
+        g.state = _spec_group_round(
+            self.params, self.draft_params, g.state,
+            self.cfg, self.draft_cfg, g.max_new, self.k, g.sampled,
+            jnp.int32(g.eos_id), g.temperature, g.top_k, g.top_p,
+        )
+        g.rounds_run += 1
+        return bool(np.asarray(g.state[7]).all())
+
+    def finish_group(self, g: "SpecGroup") -> GenerationResult:
+        """Read the group's buffers through the shared assembly."""
+        return self._assemble(
+            g.state[5], g.state[6], g.state[8], g.state[9],
+            g.max_new, g.eos_id,
+        )
+
+
+@dataclass
+class SpecGroup:
+    """Device state of a live incremental draft group (start_group)."""
+
+    state: tuple
+    max_new: int
+    eos_id: int
+    sampled: bool
+    temperature: jax.Array  # f32[B]
+    top_k: jax.Array  # i32[B]
+    top_p: jax.Array  # f32[B]
+    rounds_run: int = 0
