@@ -143,6 +143,14 @@ class StoreServer:
                         evs, rv = server._poll_events(since, timeout, kind, ns)
                         self._send(200, {
                             "resourceVersion": rv,
+                            # earliest rv still in the event ring (0 =
+                            # empty): a follower whose `since` predates
+                            # it cannot prove continuity and must full-
+                            # resync via /dump
+                            "oldestEvent": (
+                                server._events[0].resource_version
+                                if server._events else 0
+                            ),
                             "events": [
                                 {
                                     "type": e.type, "kind": e.kind,
@@ -152,6 +160,11 @@ class StoreServer:
                                 }
                                 for e in evs
                             ],
+                        })
+                    elif parts == ["dump"] and method == "GET":
+                        rv, objects = server._store.dump()
+                        self._send(200, {
+                            "resourceVersion": rv, "objects": objects,
                         })
                     elif parts == ["solve"] and method == "POST":
                         if server._solve_handler is None:
@@ -404,6 +417,29 @@ class RemoteStore:
               namespace: str | None = None) -> "RemoteWatch":
         rv = self._req("GET", "/rv")["resourceVersion"]
         return RemoteWatch(self, kind, namespace, since=rv)
+
+    # -- replication plumbing (controlplane/replica.py) -------------------
+
+    def rv(self) -> int:
+        return self._req("GET", "/rv")["resourceVersion"]
+
+    def dump(self) -> tuple[int, list]:
+        """Primary's full state for follower bootstrap/resync."""
+        resp = self._req("GET", "/dump")
+        return resp["resourceVersion"], resp["objects"]
+
+    def watch_page(self, since: int, timeout: float) -> dict:
+        """One raw long-poll page INCLUDING the gap marker
+        (``oldestEvent``) — the follower needs it to decide between
+        tailing and a full resync; RemoteWatch deliberately hides it."""
+        return self._req(
+            "GET",
+            f"/watch?since={since}&timeout={timeout}",
+            # small cushion over the server's long-poll window: the
+            # client-side timeout is the blackhole-failure detector, so
+            # it must not dwarf the replica's failover grace
+            timeout=timeout + 2.0,
+        )
 
 
 class RemoteWatch:

@@ -235,6 +235,84 @@ class Store:
         )
         self._journal_n = 0
 
+    # -- replication (follower surface) ----------------------------------
+    #
+    # The reference's control plane rides a REPLICATED etcd: a manager
+    # (or its node) can vanish and every CR/lease survives with no
+    # shared disk (election.go:72-141, llmservice_controller.go:84
+    # assume the API server outlives any client). These three methods
+    # are the follower half of that: a standby tails the primary's
+    # watch stream and applies events VERBATIM — same objects, same
+    # resourceVersion counter — into its own durable store, so a
+    # promoted standby carries full state and CAS/lease-steal
+    # continuity without shared disk.
+
+    def dump(self) -> tuple[int, list]:
+        """Consistent full-state copy for follower bootstrap/resync:
+        (rv, [[kind, ns, name, obj], ...]) — the snapshot wire shape."""
+        with self._lock:
+            return self._rv, [
+                [k.kind, k.namespace, k.name, copy.deepcopy(o)]
+                for k, o in self._objects.items()
+            ]
+
+    def load_dump(self, rv: int, objects: list,
+                  allow_regress: bool = False) -> None:
+        """Replace local state with a primary's dump. Durability goes
+        through _compact (atomic snapshot + journal rotation), so a
+        crash mid-load replays either the old state or the new one,
+        never a blend. rv normally only moves FORWARD; a follower
+        adopting a new primary whose history is shorter than its own
+        passes ``allow_regress=True`` — the serving primary's stream is
+        the fleet's truth, and the snapshot rotation makes the lower
+        counter consistent on disk (replay starts from the snapshot
+        rv)."""
+        with self._lock:
+            self._check_open()
+            if rv < self._rv and not allow_regress:
+                raise ValueError(
+                    f"dump rv {rv} behind local rv {self._rv}; refusing "
+                    "to regress the CAS counter"
+                )
+            self._objects = {
+                Key(kind, ns, name): copy.deepcopy(obj)
+                for kind, ns, name, obj in objects
+            }
+            self._rv = rv
+            if self._durable:
+                self._compact()
+
+    def apply_replicated(
+        self, op: str, kind: str, namespace: str, name: str,
+        obj: dict[str, Any] | None, rv: int,
+    ) -> None:
+        """Apply one replicated event verbatim (no new rv is minted —
+        the primary already assigned it). Idempotent on replayed rvs,
+        monotone by construction; journaled and fanned out to local
+        watchers like any native mutation."""
+        key = Key(kind, namespace, name)
+        with self._lock:
+            self._check_open()
+            if rv <= self._rv:
+                return  # replayed tail after a resync — already applied
+            self._rv = rv
+            if op == "DELETED":
+                prev = self._objects.pop(key, None)
+                self._append("delete", key, rv, None)
+                if prev is not None:
+                    self._notify("DELETED", kind, namespace, name, prev, rv)
+            else:
+                self._objects[key] = copy.deepcopy(obj)
+                self._append(
+                    "update" if op == "MODIFIED" else "create",
+                    key, rv, self._objects[key],
+                )
+                self._notify(
+                    "MODIFIED" if op == "MODIFIED" else "ADDED",
+                    kind, namespace, name, self._objects[key], rv,
+                )
+        self._sync()
+
     def close(self) -> None:
         """Flush and close the journal. Further mutations on a durable
         store raise (RuntimeError from _append) rather than silently

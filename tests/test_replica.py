@@ -1,0 +1,217 @@
+"""Store replication: journal-streaming standby + promotion with state.
+
+The etcd-replication role (r4 verdict missing #1): a follower tails the
+primary's watch stream into its own durable store, preserving objects
+AND the resourceVersion counter verbatim, so a promoted standby carries
+the full control plane — CAS/lease-steal continuity included — with no
+shared disk. The cross-process story (kill -9 the leader, standby binds
+the frontend and the fleet reconverges) lives in test_process_e2e.py;
+these tests pin the replication machinery in-process.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore, StoreServer
+from kubeinfer_tpu.controlplane.replica import StoreReplica
+from kubeinfer_tpu.controlplane.store import Store
+
+
+def wait_until(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _obj(name, i=0, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns}, "spec": {"i": i}}
+
+
+class TestReplicatedApply:
+    def test_apply_preserves_rv_verbatim(self, tmp_path):
+        a = Store()
+        b = Store(data_dir=tmp_path / "b")
+        w = a.watch()  # capture the full history for verbatim replay
+        a.create("Node", _obj("n1"))
+        o = a.get("Node", "n1")
+        o["spec"]["i"] = 5
+        a.update("Node", o)
+        a.create("Node", _obj("n2"))
+        a.delete("Node", "n2")
+        for e in w.drain():
+            b.apply_replicated(
+                e.type, e.kind, e.namespace, e.name, e.object,
+                e.resource_version,
+            )
+        assert b._rv == a._rv
+        assert b.get("Node", "n1") == a.get("Node", "n1")
+        with pytest.raises(KeyError):
+            b.get("Node", "n2")
+        # replayed rvs are idempotent (resync overlap)
+        b.apply_replicated("ADDED", "Node", "default", "n1", _obj("n1"), 1)
+        assert b.get("Node", "n1")["spec"]["i"] == 5
+
+    def test_replica_survives_restart_with_rv(self, tmp_path):
+        b = Store(data_dir=tmp_path / "b")
+        b.apply_replicated("ADDED", "Node", "default", "n1", _obj("n1"), 7)
+        b.close()
+        b2 = Store(data_dir=tmp_path / "b")
+        assert b2._rv == 7
+        assert b2.get("Node", "n1")["metadata"]["name"] == "n1"
+
+    def test_load_dump_refuses_rv_regression(self, tmp_path):
+        b = Store(data_dir=tmp_path / "b")
+        b.apply_replicated("ADDED", "Node", "default", "n1", _obj("n1"), 9)
+        with pytest.raises(ValueError, match="regress"):
+            b.load_dump(3, [["Node", "default", "nx", _obj("nx")]])
+
+    def test_load_dump_atomic_snapshot(self, tmp_path):
+        b = Store(data_dir=tmp_path / "b")
+        b.load_dump(12, [["Node", "default", "n1", _obj("n1", 3)]])
+        b.close()
+        b2 = Store(data_dir=tmp_path / "b")
+        assert b2._rv == 12
+        assert b2.get("Node", "n1")["spec"]["i"] == 3
+
+
+class TestStoreReplicaFollow:
+    def _primary(self, store):
+        server = StoreServer(store, "127.0.0.1", 0).start()
+        return server, RemoteStore(server.address)
+
+    def test_bootstrap_and_tail(self, tmp_path):
+        a = Store()
+        # pre-existing state exercises the /dump bootstrap (the event
+        # ring never saw these writes from the follower's perspective)
+        a.create("Node", _obj("n1", 1))
+        a.create("LLMService", _obj("svc", 2))
+        server, remote = self._primary(a)
+        try:
+            rep = StoreReplica(
+                RemoteStore(server.address, request_timeout_s=5.0),
+                data_dir=tmp_path / "rep", poll_timeout_s=0.3,
+            )
+            rep.start(lambda: False)
+            assert rep.wait_synced(10)
+            wait_until(lambda: rep.store._rv == a._rv, 10, "bootstrap")
+            # live tail: new writes stream through the watch ring
+            o = a.get("Node", "n1")
+            o["spec"]["i"] = 42
+            a.update("Node", o)
+            a.create("Node", _obj("n3"))
+            a.delete("LLMService", "svc")
+            wait_until(lambda: rep.store._rv == a._rv, 10, "tail")
+            assert rep.store.get("Node", "n1")["spec"]["i"] == 42
+            assert rep.store.get("Node", "n3")["metadata"]["name"] == "n3"
+            with pytest.raises(KeyError):
+                rep.store.get("LLMService", "svc")
+            rep.stop()
+        finally:
+            server.shutdown()
+
+    def test_promotion_callback_after_grace(self, tmp_path):
+        a = Store()
+        a.create("Node", _obj("n1"))
+        server, _ = self._primary(a)
+        promoted = []
+
+        def on_dead():
+            promoted.append(True)
+            return True
+
+        rep = StoreReplica(
+            RemoteStore(server.address, request_timeout_s=1.0),
+            data_dir=tmp_path / "rep",
+            failover_grace_s=0.5, poll_timeout_s=0.3,
+        )
+        rep.start(on_dead)
+        try:
+            assert rep.wait_synced(10)
+            rv_before = rep.store._rv
+            server.shutdown()  # primary dies
+            wait_until(lambda: rep.promoted.is_set(), 15, "promotion")
+            assert promoted
+            # the promoted store still carries the primary's state + rv
+            assert rep.store._rv == rv_before
+            assert rep.store.get("Node", "n1")["metadata"]["name"] == "n1"
+            # promoted replica's store stays OPEN (ownership moved to
+            # the serving manager)
+            rep.stop()
+            rep.store.create("Node", _obj("n9"))
+            assert rep.store._rv == rv_before + 1
+        finally:
+            rep.store.close()
+
+    def test_lost_bind_race_resumes_following(self, tmp_path):
+        a = Store(data_dir=tmp_path / "a")
+        a.create("Node", _obj("n1"))
+        server, _ = self._primary(a)
+        port_holder = {}
+        port_holder["addr"] = server.address
+
+        attempts = []
+
+        def on_dead():
+            attempts.append(True)
+            if len(attempts) == 1:
+                # sibling won the race: a NEW primary appears at a new
+                # address... here we just restart one and repoint the
+                # follower's remote (same-address semantics in prod)
+                return False
+            return True
+
+        rep = StoreReplica(
+            RemoteStore(server.address, request_timeout_s=1.0),
+            data_dir=tmp_path / "rep",
+            failover_grace_s=0.4, poll_timeout_s=0.3,
+        )
+        rep.start(on_dead)
+        try:
+            assert rep.wait_synced(10)
+            server.shutdown()
+            wait_until(lambda: len(attempts) >= 2, 20, "second attempt")
+            rep.stop()
+        finally:
+            a.close()
+
+    def test_divergence_repair_adopts_shorter_primary(self, tmp_path):
+        """A follower AHEAD of the serving primary (it was better-
+        replicated but lost the bind race) must adopt the primary's
+        shorter history wholesale — keeping its surplus records would
+        silently diverge forever (the primary's events at already-
+        passed rvs are filtered out of its watch stream)."""
+        seed = Store(data_dir=tmp_path / "rep")
+        seed.apply_replicated("ADDED", "Node", "default", "n1", _obj("n1"), 3)
+        seed.apply_replicated(
+            "ADDED", "LLMService", "default", "ghost", _obj("ghost"), 10
+        )
+        seed.close()
+
+        a = Store()  # the new primary: shorter history, no ghost
+        a.create("Node", _obj("n1", 1))  # rv 1
+        server, _ = self._primary(a)
+        try:
+            rep = StoreReplica(
+                RemoteStore(server.address, request_timeout_s=5.0),
+                data_dir=tmp_path / "rep", poll_timeout_s=0.3,
+            )
+            assert rep.store._rv == 10  # replayed the stale surplus
+            rep.start(lambda: False)
+            wait_until(
+                lambda: rep.store._rv == a._rv, 10, "divergence repair"
+            )
+            with pytest.raises(KeyError):
+                rep.store.get("LLMService", "ghost")
+            # and the tail is live on the adopted base
+            a.create("Node", _obj("n2"))
+            wait_until(lambda: rep.store._rv == a._rv, 10, "tail")
+            assert rep.store.get("Node", "n2")["metadata"]["name"] == "n2"
+            rep.stop()
+        finally:
+            server.shutdown()
