@@ -59,6 +59,92 @@ def build_request(J, N, seed=0, gang_fraction=0.0):
     )
 
 
+def native_cross_run_stats(J, N, gang_fraction, reps, runs=3, seed=0):
+    """Cross-PROCESS dispersion of the native scorer (r4 verdict item
+    1): within-run IQR was tight while run-to-run medians drifted
+    27-34ms at 10k across rounds, so the ratio's honest error bar is
+    the spread of INDEPENDENT process runs — fresh .so load, fresh
+    allocator state, fresh CPU frequency/cache context — not the IQR.
+    Each run re-execs this file with --native-probe (same deterministic
+    build_request instance) and reports its own median; the caller
+    publishes the run medians and their min/max alongside the in-process
+    number."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    # the probe never touches JAX/TPU; forcing CPU keeps a wedged relay
+    # from hanging the subprocess at import
+    env["JAX_PLATFORMS"] = "cpu"
+    meds = []
+    for _ in range(runs):
+        # any probe failure (nonzero exit, hang, garbled stdout) must
+        # degrade to an error KEY — bench's one-JSON-line contract with
+        # the driver outranks the dispersion measurement
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--native-probe", str(J), str(N), str(gang_fraction),
+                    str(reps), str(seed),
+                ],
+                capture_output=True, text=True, env=env, timeout=900,
+            )
+            if out.returncode != 0:
+                return {"error": out.stderr.strip()[-300:]}
+            meds.append(json.loads(out.stdout.strip().splitlines()[-1]))
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+    p50s = [round(m["p50_ms"], 3) for m in meds]
+    return {
+        "runs": p50s,
+        "min": min(p50s),
+        "max": max(p50s),
+        "placed": meds[0]["placed"],
+    }
+
+
+def native_probe_main(argv):
+    """--native-probe J N GANG_FRACTION REPS: one independent native-
+    scorer run; prints a single JSON line (consumed by
+    native_cross_run_stats)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from kubeinfer_tpu.scheduler import get_backend
+
+    J, N = int(argv[0]), int(argv[1])
+    gang, reps = float(argv[2]), int(argv[3])
+    seed = int(argv[4]) if len(argv) > 4 else 0
+    req = build_request(J, N, seed=seed, gang_fraction=gang)
+    native = get_backend("native-greedy")
+    native.solve(req)  # warm (.so load, first-touch pages)
+    stats = time_backend(native, req, reps)
+    print(json.dumps({"p50_ms": stats["p50_ms"], "placed": stats["placed"]}))
+    return 0
+
+
+def _native_dispersion_keys(prefix, J, N, gang, reps, dev_ms, seed=0):
+    """Extras fragment: run medians + min/max + the ratio-vs-device
+    range for one native cross-run measurement."""
+    cross = native_cross_run_stats(J, N, gang, reps, seed=seed)
+    if "error" in cross:
+        return {f"{prefix}_runs_error": cross["error"]}
+    ratio_key = (
+        "device_vs_native_50k" if prefix.endswith("50k")
+        else "device_vs_native"
+    )
+    return {
+        f"{prefix}_runs": cross["runs"],
+        f"{prefix}_run_min": cross["min"],
+        f"{prefix}_run_max": cross["max"],
+        f"{ratio_key}_min": round(cross["min"] / max(dev_ms, 1e-9), 2),
+        f"{ratio_key}_max": round(cross["max"] / max(dev_ms, 1e-9), 2),
+    }
+
+
 def time_backend(backend, req, reps):
     times, encodes = [], []
     placed = 0
@@ -237,7 +323,7 @@ V5E_HBM_BYTES_PER_S = 819e9
 
 
 def inference_bench(short_new=8, long_new=128, prompt_len=512,
-                    long_prompt_len=2048):
+                    long_prompt_len=2048, model="bench-280m"):
     """Native-engine serving throughput on the live device — BOTH phases.
 
     Decode: generate() at two max_new_tokens values; the difference is
@@ -259,7 +345,7 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
     from kubeinfer_tpu.inference import PRESETS, init_params
     from kubeinfer_tpu.inference.engine import Engine
 
-    cfg = PRESETS["bench-280m"]
+    cfg = PRESETS[model]
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     engine = Engine(params, cfg)
@@ -334,7 +420,7 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
     b_tps = B * steps / b_dt
 
     return {
-        "model": "bench-280m",
+        "model": model,
         "params": n_params,
         "decode_ms_per_token": round(per_step_ms, 3),
         "decode_tokens_per_sec": round(1e3 / per_step_ms, 1),
@@ -462,6 +548,12 @@ def main() -> None:
         "native_p50_iqr_ms": round(native_stats["iqr_ms"], 3),
         "native_p95_ms": round(native_stats["p95_ms"], 3),
         "device_vs_native": round(native_stats["p50_ms"] / max(dev_ms, 1e-9), 2),
+        # cross-PROCESS dispersion (r4 verdict item 1): the in-process
+        # IQR is tight while independent runs drift, so the published
+        # ratio carries a measured range, not a point
+        **_native_dispersion_keys(
+            "native_p50", 10_000, 1_000, 0.2, max(reps // 2, 3), dev_ms
+        ),
         # end-to-end through the remote PJRT relay this environment uses
         # (includes the ~90-130ms transport round trip local attachment
         # does not pay); p95-p50 gap here is relay noise, not solver
@@ -512,6 +604,10 @@ def main() -> None:
                 extras["native_50k_ms"] = round(n50["p50_ms"], 3)
                 extras["native_50k_iqr_ms"] = round(n50["iqr_ms"], 3)
                 extras["native_50k_placed"] = n50["placed"]
+                extras.update(_native_dispersion_keys(
+                    "native_50k", 50_000, 1_000, 0.1,
+                    max(reps // 4, 3), dev50, seed=1,
+                ))
                 extras["device_vs_native_50k"] = round(
                     n50["p50_ms"] / max(dev50, 1e-9), 2
                 )
@@ -573,6 +669,20 @@ def main() -> None:
             extras["native_engine_prefill_mfu"] = inf["prefill_mfu"]
         except Exception as e:  # bench must always emit its JSON line
             extras["native_engine_error"] = f"{type(e).__name__}: {e}"
+        # serving-scale model (r4 verdict item 3): the same phase keys
+        # at ~1.7B, where HBM pressure, bucketing, and flash actually
+        # bite; suffixing keeps the 280M keys' round-over-round history
+        try:
+            big = inference_bench(model="bench-1p7b")
+            extras["native_engine_params_1p7b"] = big["params"]
+            for key in (
+                "decode_ms_per_token", "decode_tokens_per_sec",
+                "decode_hbm_frac", "decode_tokens_per_sec_b8",
+                "prefill_tokens_per_sec", "prefill_mfu",
+            ):
+                extras[f"native_engine_{key}_1p7b"] = big[key]
+        except Exception as e:
+            extras["native_engine_1p7b_error"] = f"{type(e).__name__}: {e}"
 
     print(
         json.dumps(
@@ -593,4 +703,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import sys as _sys
+
+    if len(_sys.argv) > 1 and _sys.argv[1] == "--native-probe":
+        # must run before _ensure_backend_alive: the probe is pure CPU
+        # and must not block on (or re-exec around) a wedged relay
+        raise SystemExit(native_probe_main(_sys.argv[2:]))
     main()

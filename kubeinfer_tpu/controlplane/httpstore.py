@@ -143,6 +143,13 @@ class StoreServer:
                         evs, rv = server._poll_events(since, timeout, kind, ns)
                         self._send(200, {
                             "resourceVersion": rv,
+                            # the store's ACTUAL counter, unclamped by
+                            # `since` (the rv above is a watch cursor,
+                            # floored at the caller's position): a
+                            # follower compares this against its local
+                            # cursor to detect a primary whose history
+                            # is BEHIND it (restart with fresh state)
+                            "storeRv": server._store._rv,
                             # earliest rv still in the event ring (0 =
                             # empty): a follower whose `since` predates
                             # it cannot prove continuity and must full-

@@ -116,6 +116,15 @@ class StoreReplica:
                 page = self._remote.watch_page(self.store._rv, self._poll)
                 oldest = page.get("oldestEvent", 0)
                 tip = page["resourceVersion"]
+                if page.get("storeRv", tip) < self.store._rv:
+                    # the primary's ACTUAL counter is BEHIND our cursor
+                    # (restarted with a shorter/fresh history inside the
+                    # grace window; the watch cursor itself is clamped
+                    # to `since` so `tip` can never show this): every
+                    # page would no-op forever while we report synced —
+                    # divergence repair via _maybe_resync
+                    need_resync_check = True
+                    continue
                 if tip > self.store._rv and (
                     oldest == 0 or oldest > self.store._rv + 1
                 ):

@@ -147,6 +147,20 @@ PRESETS: dict[str, ModelConfig] = {
         num_key_value_heads=8,
         max_position_embeddings=4096,
     ),
+    # serving-scale benchmark model (~1.7B params, llama-family shape):
+    # big enough that HBM pressure, bucketing, and flash attention bite
+    # (r4 verdict item 3 — every published serving number was 280M),
+    # small enough to random-init on a 16GB v5e chip with headroom for
+    # KV caches (bf16 weights ~3.5GB)
+    "bench-1p7b": ModelConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_hidden_layers=24,
+        num_attention_heads=16,
+        num_key_value_heads=8,
+        max_position_embeddings=4096,
+    ),
     "qwen2-7b": ModelConfig(
         vocab_size=152064,
         hidden_size=3584,

@@ -85,10 +85,24 @@ def _softmax_fold(
     groups: int,
     scale: float,
     s_tiles: int,
+    active=None,  # scalar bool: False = this tile provably contributes 0
 ):
     """One S-tile step of the online softmax, shared by both kernels —
     the recurrence, scratch lifecycle, and GQA penalty broadcast must
-    never diverge between the mask-tensor and iota-mask variants."""
+    never diverge between the mask-tensor and iota-mask variants.
+
+    ``active=False`` skips the fold for a tile whose every slot is
+    masked. BIT-identical by construction, not an approximation: with
+    pen == -1e30 everywhere, s == -1e30 exactly (f32 absorbs the |qk|
+    term), so m_new == m_prev, alpha == 1, and p == exp(-1e30 - m)
+    underflows to exactly 0 — the skipped fold would add 0 to l and acc
+    and rewrite m with itself. The one exception is a row that has seen
+    NO unmasked tile yet (m == -1e30, making p == 1, not 0) — callers
+    must keep such rows' tiles active (the ragged kernels run row_len==0
+    rows dense, preserving their defined uniform-average output). This
+    is the prefill MFU lever (r4 verdict item 2): the causal upper
+    triangle is ~half of every prefill grid, and the fold's exp/max VPU
+    sweep — not the MXU matmuls — is what those tiles burn."""
     ts = pl.program_id(2)  # innermost: S sweep with resident scratch
 
     @pl.when(ts == 0)
@@ -97,31 +111,39 @@ def _softmax_fold(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]  # [TqG, D]
-    k = k_ref[0]  # [Sk, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [TqG, Sk]
-    # Masking as an f32 additive penalty broadcast across the G subrows.
-    # Mosaic cannot relayout i1 vectors ("unsupported shape cast" on a
-    # bool [Tq, 1, Sk] broadcast), so rank changes happen on f32 values;
-    # the add is exact (|s| << 1e23, so s + -1e30 rounds to -1e30).
-    tq, sk = pen.shape
-    s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
-        tq * groups, sk
-    )
+    def _fold():
+        q = q_ref[0]  # [TqG, D]
+        k = k_ref[0]  # [Sk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TqG, Sk]
+        # Masking as an f32 additive penalty broadcast across the G
+        # subrows. Mosaic cannot relayout i1 vectors ("unsupported shape
+        # cast" on a bool [Tq, 1, Sk] broadcast), so rank changes happen
+        # on f32 values; the add is exact (|s| << 1e23, so s + -1e30
+        # rounds to -1e30).
+        tq, sk = pen.shape
+        s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
+            tq * groups, sk
+        )
 
-    m_prev = m_scr[:]  # [TqG, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)  # [TqG, Sk] f32
-    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [TqG, D]
-    acc_scr[:] = acc_scr[:] * alpha + pv
-    m_scr[:] = m_new
+        m_prev = m_scr[:]  # [TqG, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [TqG, Sk] f32
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [TqG, D]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    if active is None:
+        _fold()
+    else:
+        pl.when(active)(_fold)
 
     @pl.when(ts == s_tiles - 1)
     def _finish():
@@ -175,6 +197,23 @@ def _flash_ragged_kernel(
     _softmax_fold(
         q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
         groups=groups, scale=scale, s_tiles=s_tiles,
+        active=_ragged_tile_active(
+            c0_ref[0], row_len, tq, ts, tile_t, tile_s
+        ),
+    )
+
+
+def _ragged_tile_active(c0, row_len, tq, ts, tile_t, tile_s):
+    """Whether this (tq, ts) tile can contain any unmasked slot under
+    the causal+length mask. Tile 0 of the S sweep always runs — it owns
+    the scratch init, and keeping every tile of a row_len == 0 row
+    active preserves that row's defined output (see _softmax_fold)."""
+    s_start = ts * tile_s
+    q_max = c0 + (tq + 1) * tile_t - 1
+    return (
+        (ts == 0)
+        | (row_len == 0)
+        | ((s_start <= q_max) & (s_start < row_len))
     )
 
 
@@ -356,6 +395,9 @@ def _flash_ragged_lse_kernel(
     _softmax_fold(
         q_ref, k_ref, v_ref, pen, o_ref, m_scr, l_scr, acc_scr,
         groups=groups, scale=scale, s_tiles=s_tiles,
+        active=_ragged_tile_active(
+            c0_ref[0], row_len, tq, ts, tile_t, tile_s
+        ),
     )
 
     @pl.when(ts == s_tiles - 1)
@@ -397,19 +439,29 @@ def _flash_bwd_dq_kernel(
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     row_len = len_ref[pl.program_id(0) // n_kv]
-    pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
-    p = _recompute_p(
-        q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
+
+    # Fully-masked tiles contribute exactly 0 (p underflows to 0 against
+    # the row-global lse; row_len == 0 rows are gated to p == 0 inside
+    # _recompute_p), so skipping them is bit-identical — same causal
+    # upper-triangle VPU saving as the forward's _ragged_tile_active.
+    @pl.when(
+        (ts * tile_s <= c0_ref[0] + (tq + 1) * tile_t - 1)
+        & (ts * tile_s < row_len)
     )
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [TqG, Sk]
-    ds = p * (dp - drow_ref[0])
-    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+    def _accum():
+        pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
+        p = _recompute_p(
+            q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [TqG, Sk]
+        ds = p * (dp - drow_ref[0])
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(ts == s_tiles - 1)
     def _finish():
@@ -434,25 +486,33 @@ def _flash_bwd_dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     row_len = len_ref[pl.program_id(0) // n_kv]
-    pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
-    p = _recompute_p(
-        q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
+
+    # same provably-zero-tile skip as the dq kernel (grid here is
+    # (bn, ts, tq), so the guard reads the swapped program ids)
+    @pl.when(
+        (ts * tile_s <= c0_ref[0] + (tq + 1) * tile_t - 1)
+        & (ts * tile_s < row_len)
     )
-    # dv += p^T dO; the folded (t, g) rows make the GQA group reduction
-    # implicit in the row contraction
-    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - drow_ref[0])
-    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+    def _accum():
+        pen = _ragged_pen(c0_ref[0], row_len, tq, ts, tile_t, tile_s)
+        p = _recompute_p(
+            q_ref[0], k_ref[0], pen, lse_ref[0], row_len, groups, scale
+        )
+        # dv += p^T dO; the folded (t, g) rows make the GQA group
+        # reduction implicit in the row contraction
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - drow_ref[0])
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(tq == t_tiles - 1)
     def _finish():

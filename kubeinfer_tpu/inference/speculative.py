@@ -65,8 +65,8 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import (
     GenerationResult,
-    PREFILL_CHUNK,
     chunked_prefill,
+    prefill_chunk_for,
     filter_logits,
     gumbel_pick,
     make_caches,
@@ -496,7 +496,8 @@ class SpeculativeEngine:
             self.params, self.draft_params,
             jnp.asarray(padded), jnp.asarray(lens),
             self.cfg, self.draft_cfg,
-            max_new_tokens, cache_len, self.k, PREFILL_CHUNK,
+            max_new_tokens, cache_len, self.k,
+            prefill_chunk_for(B, int(padded.shape[1])),
             jnp.int32(eos_id),
             sampled=temperature > 0,
             temperature=jnp.float32(temperature),
@@ -570,7 +571,8 @@ class SpeculativeEngine:
             self.params, self.draft_params,
             jnp.asarray(padded), jnp.asarray(lens),
             self.cfg, self.draft_cfg,
-            max_new_tokens, cache_len, self.k, PREFILL_CHUNK,
+            max_new_tokens, cache_len, self.k,
+            prefill_chunk_for(B, int(padded.shape[1])),
             jnp.int32(eos_id), sampled, temperature, top_k, top_p,
             jax.random.PRNGKey(seed),
         )

@@ -197,7 +197,12 @@ class Manager:
             # not reconcile) but operators and the e2e need to know when
             # its journal tail is live before trusting a failover
             health_routes["/replicaz"] = lambda: (
-                (200, "text/plain", "synced\n")
+                # promoted replicas stop tailing (they ARE the primary
+                # now) — keep reporting 200 or the route reads as a
+                # replica that lost its journal tail
+                (200, "text/plain", "promoted\n")
+                if self._replica.promoted.is_set()
+                else (200, "text/plain", "synced\n")
                 if self._replica.synced
                 else (503, "text/plain", "syncing\n")
             )

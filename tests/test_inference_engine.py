@@ -57,15 +57,45 @@ class TestEngine:
     @pytest.mark.slow
     def test_chunked_prefill_multi_chunk_exact(self, monkeypatch):
         """Prefill split across several chunks must equal the one-shot
-        forward (patch the chunk small so test-sized prompts span >1)."""
+        forward (patch BOTH the chunk floor and the token budget small —
+        prefill_chunk_for takes max(PREFILL_CHUNK, budget//B), so
+        patching the floor alone would leave test-sized prompts
+        single-chunk and silently stop covering the cross-chunk carry)."""
         import kubeinfer_tpu.inference.engine as eng
 
         monkeypatch.setattr(eng, "PREFILL_CHUNK", 8)
+        monkeypatch.setattr(eng, "PREFILL_TOKEN_BUDGET", 8)
         params = init_params(TINY, jax.random.PRNGKey(4))
         engine = Engine(params, TINY)
         prompt = list(np.random.default_rng(13).integers(1, 200, 27))
         out = engine.generate([prompt], max_new_tokens=5)
         assert out.tokens[0].tolist() == ref_greedy(params, prompt, 5)
+
+    def test_prefill_chunk_always_divides_bucket(self):
+        """prefill_chunk_for must return a divisor of the bucket for ANY
+        batch size: a non-dividing chunk makes the scan's final
+        dynamic_slice clamp and silently re-process tokens at wrong
+        positions (review-found with batch=3 -> 2048//3=682)."""
+        from kubeinfer_tpu.inference.engine import (
+            PROMPT_BUCKETS,
+            prefill_chunk_for,
+        )
+
+        for bucket in PROMPT_BUCKETS:
+            for batch in (1, 2, 3, 5, 7, 8, 16):
+                c = prefill_chunk_for(batch, bucket)
+                assert c >= 1 and bucket % c == 0, (batch, bucket, c)
+
+    def test_three_row_group_prefill_exact(self):
+        """End-to-end regression for the batch=3 divisor bug: 3 rows of
+        the same >chunk length must decode exactly like the reference."""
+        params = init_params(TINY, jax.random.PRNGKey(4))
+        engine = Engine(params, TINY)
+        rng = np.random.default_rng(5)
+        prompts = [list(rng.integers(1, 200, 21)) for _ in range(3)]
+        out = engine.generate(prompts, max_new_tokens=4)
+        for b, p in enumerate(prompts):
+            assert out.tokens[b].tolist() == ref_greedy(params, p, 4), b
 
     def test_single_new_token(self):
         # regression: max_new_tokens=1 used to feed lax.scan a 1-key xs
