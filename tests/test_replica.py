@@ -215,3 +215,51 @@ class TestStoreReplicaFollow:
             rep.stop()
         finally:
             server.shutdown()
+
+    def test_live_tail_detects_behind_primary(self, tmp_path):
+        """A primary restarted with SHORTER history behind the same
+        address must be detected from the live tail, not only at
+        bootstrap: the watch cursor is clamped to `since`, so detection
+        rides the page's storeRv field. The follower adopts the new
+        primary's state and resumes tailing it."""
+        a = Store()
+        a.create("Node", _obj("n1"))
+        for i in range(5):
+            o = a.get("Node", "n1")
+            o["spec"]["i"] = i
+            a.update("Node", o)
+        server, _ = self._primary(a)
+        port = server.port
+        rep = StoreReplica(
+            RemoteStore(server.address, request_timeout_s=2.0),
+            data_dir=tmp_path / "rep",
+            failover_grace_s=30.0,  # never promote in this test
+            poll_timeout_s=0.2,
+        )
+        rep.start(lambda: False)
+        try:
+            assert rep.wait_synced(10)
+            wait_until(lambda: rep.store._rv == a._rv, 10, "initial sync")
+            assert rep.store._rv == 6
+            server.shutdown()
+            # fresh primary, same port, shorter history (rv 1)
+            b = Store()
+            b.create("Node", _obj("n2", 9))
+            server2 = StoreServer(b, "127.0.0.1", port).start()
+            try:
+                wait_until(
+                    lambda: rep.store._rv == b._rv, 20,
+                    "behind-primary adoption",
+                )
+                assert rep.store.get("Node", "n2")["spec"]["i"] == 9
+                with pytest.raises(KeyError):
+                    rep.store.get("Node", "n1")
+                # and the tail is live on the adopted base
+                b.create("Node", _obj("n3"))
+                wait_until(
+                    lambda: rep.store._rv == b._rv, 10, "live tail"
+                )
+            finally:
+                server2.shutdown()
+        finally:
+            rep.stop()
