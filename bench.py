@@ -89,10 +89,16 @@ def native_cross_run_stats(J, N, gang_fraction, reps, runs=3, seed=0):
                     "--native-probe", str(J), str(N), str(gang_fraction),
                     str(reps), str(seed),
                 ],
-                capture_output=True, text=True, env=env, timeout=900,
+                # the probe takes ~seconds; the cap must stay under the
+                # stall watchdog's threshold or a hung probe would block
+                # the main thread past it with no progress touch
+                capture_output=True, text=True, env=env, timeout=300,
             )
             if out.returncode != 0:
                 return {"error": out.stderr.strip()[-300:]}
+            # a slow-but-sane host-side probe must not read as a device
+            # stall (the probe has its own 900s budget above)
+            _touch_progress()
             meds.append(json.loads(out.stdout.strip().splitlines()[-1]))
         except Exception as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}"}
@@ -150,6 +156,7 @@ def time_backend(backend, req, reps):
     placed = 0
     for _ in range(reps):
         res = backend.solve(req)
+        _touch_progress()
         times.append(res.solve_ms)
         # KeyError loudly if a backend stops reporting encode_ms: the
         # headline pack+solve latency is built from it, and a silent 0.0
@@ -258,7 +265,9 @@ def device_solve_ms(req, k_short=8, k_long=80, reps=7, solve_fn=None):
     tiny = jax.device_put(np.ones(8, np.float32))
     np.asarray(floor_probe(tiny))
     np.asarray(short(p)[1])
+    _touch_progress()
     np.asarray(long_(p)[1])  # compile all
+    _touch_progress()
 
     floors, shorts, longs = [], [], []
     for _ in range(reps):
@@ -271,6 +280,7 @@ def device_solve_ms(req, k_short=8, k_long=80, reps=7, solve_fn=None):
         t0 = time.perf_counter()
         np.asarray(long_(p)[1])
         longs.append(time.perf_counter() - t0)
+        _touch_progress()
     per_solve = (statistics.median(longs) - statistics.median(shorts)) / (
         k_long - k_short
     )
@@ -355,9 +365,13 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
 
     # compile all variants
     engine.generate([prompt], max_new_tokens=short_new)
+    _touch_progress()
     engine.generate([prompt], max_new_tokens=long_new)
+    _touch_progress()
     engine.generate([prompt_long], max_new_tokens=1)
+    _touch_progress()
     engine.generate([prompt], max_new_tokens=1)
+    _touch_progress()
     # 5 reps: the prefill difference (~25ms) sits close to the relay's
     # per-call jitter, and 3-rep medians left the published MFU drifting
     # ~2x between runs
@@ -369,12 +383,14 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
         t0 = time.perf_counter()
         engine.generate([prompt], max_new_tokens=long_new)
         longs.append(time.perf_counter() - t0)
+        _touch_progress()
         t0 = time.perf_counter()
         engine.generate([prompt], max_new_tokens=1)
         pf_shorts.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         engine.generate([prompt_long], max_new_tokens=1)
         pf_longs.append(time.perf_counter() - t0)
+        _touch_progress()
     dt = statistics.median(longs) - statistics.median(shorts)
     steps = long_new - short_new
     per_step_ms = max(dt, 1e-9) / steps * 1e3
@@ -405,7 +421,9 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
         for _ in range(B)
     ]
     engine.generate(prompts8, max_new_tokens=short_new)
+    _touch_progress()
     engine.generate(prompts8, max_new_tokens=long_new)
+    _touch_progress()
     b_shorts, b_longs = [], []
     for _ in range(5):
         t0 = time.perf_counter()
@@ -414,6 +432,7 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
         t0 = time.perf_counter()
         engine.generate(prompts8, max_new_tokens=long_new)
         b_longs.append(time.perf_counter() - t0)
+        _touch_progress()
     b_dt = max(
         statistics.median(b_longs) - statistics.median(b_shorts), 1e-9
     )
@@ -431,6 +450,53 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
         "prefill_tokens_per_sec": round(pf_tps, 1),
         "prefill_mfu": round((pf_flops / pf_dt) / V5E_PEAK_BF16_FLOPS, 3),
     }
+
+
+_last_progress = [0.0]
+
+
+def _touch_progress() -> None:
+    _last_progress[0] = time.monotonic()
+
+
+def _start_stall_watchdog(stall_s: float = 480.0) -> None:
+    """Re-exec on CPU if device work stalls MID-RUN.
+
+    _ensure_backend_alive catches a relay that is dead at startup; this
+    catches one that wedges between phases (observed r5: jax.devices()
+    hung for hours after working earlier in the same session). Device-
+    touching loops call _touch_progress; a daemon thread re-execs with
+    the CPU fallback env when no progress lands within ``stall_s`` —
+    same rationale as the startup probe: a CPU line beats no line. The
+    margin sits far above the longest legitimate gap (a cold 1.7B-model
+    compile through the relay, minutes)."""
+    import os
+    import sys
+    import threading
+
+    if os.environ.get("_KUBEINFER_BENCH_CPU_FALLBACK") == "1":
+        return
+    _touch_progress()
+
+    def watch():
+        while True:
+            time.sleep(30.0)
+            if time.monotonic() - _last_progress[0] > stall_s:
+                print(
+                    f"# device work stalled >{stall_s:.0f}s mid-bench; "
+                    "re-running on CPU", file=sys.stderr,
+                )
+                from kubeinfer_tpu.utils.env import scrub_axon_pythonpath
+
+                env = dict(os.environ)
+                env["_KUBEINFER_BENCH_CPU_FALLBACK"] = "1"
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PYTHONPATH"] = scrub_axon_pythonpath(
+                    env.get("PYTHONPATH", "")
+                )
+                os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    threading.Thread(target=watch, daemon=True, name="stall-watchdog").start()
 
 
 def _ensure_backend_alive(timeout_s: float = 180.0) -> None:
@@ -495,6 +561,7 @@ def main() -> None:
     args = ap.parse_args()
 
     _ensure_backend_alive()
+    _start_stall_watchdog()
     import os
 
     if os.environ.get("_KUBEINFER_BENCH_CPU_FALLBACK") == "1":
