@@ -97,7 +97,8 @@ def native_cross_run_stats(J, N, gang_fraction, reps, runs=3, seed=0):
             if out.returncode != 0:
                 return {"error": out.stderr.strip()[-300:]}
             # a slow-but-sane host-side probe must not read as a device
-            # stall (the probe has its own 900s budget above)
+            # stall (the probe's 300s cap above sits under the watchdog
+            # threshold by design)
             _touch_progress()
             meds.append(json.loads(out.stdout.strip().splitlines()[-1]))
         except Exception as e:  # noqa: BLE001

@@ -215,3 +215,48 @@ class TestSampleCRs:
         for doc in docs:
             svc = LLMService.from_dict(doc)
             svc.validate()  # raises on an invalid sample
+
+
+class TestStandbyManifest:
+    """manager-standby.yaml: the replica standby's flags must parse
+    against the real CLI and wire the replica mode (store-connect +
+    data-dir + leader-elect), with its state on a mounted volume."""
+
+    def _dep(self):
+        docs = _load_all(
+            os.path.join(DEPLOY, "kubernetes", "manager-standby.yaml")
+        )
+        return next(d for d in docs if d["kind"] == "Deployment")
+
+    def test_args_parse_against_the_real_cli(self):
+        from kubeinfer_tpu.manager.__main__ import build_parser
+
+        args = [
+            a for c in _containers(self._dep())
+            for a in c.get("args", [])
+        ]
+        assert args
+        ns = build_parser().parse_args(args)
+        # replica mode = store-connect + data-dir (manager/__init__.py)
+        assert ns.store_connect and ns.data_dir and ns.leader_elect
+
+    def test_data_dir_is_on_a_mounted_volume(self):
+        from kubeinfer_tpu.manager.__main__ import build_parser
+
+        dep = self._dep()
+        c = _containers(dep)[0]
+        ns = build_parser().parse_args(c["args"])
+        mounts = [m["mountPath"] for m in c.get("volumeMounts", [])]
+        assert any(
+            ns.data_dir == m or ns.data_dir.startswith(m + "/")
+            for m in mounts
+        ), (ns.data_dir, mounts)
+
+    def test_standby_connects_to_the_manager_service(self):
+        c = _containers(self._dep())[0]
+        connect = next(
+            a for a in c["args"] if a.startswith("--store-connect=")
+        )
+        # the Service name from manager.yaml — readiness-gated failover
+        # depends on both Deployments sitting behind the same Service
+        assert "kubeinfer-manager:18080" in connect
