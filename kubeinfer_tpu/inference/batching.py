@@ -308,6 +308,7 @@ class ContinuousEngine:
         # reshapes p from state the verifier window cannot see).
         self.speculative = speculative
         self.spec_served = 0  # telemetry: requests served via the draft
+        self.spec_accepted = 0  # telemetry: accepted draft tokens, all groups
         # (member requests, live group handle) — at most one in flight
         self._spec_group: tuple[list[_Request], object] | None = None
         # arrival-order head popped from the queue but not yet placeable
@@ -580,6 +581,9 @@ class ContinuousEngine:
             if self._spec_group is not live:
                 return  # stop() already failed the members
             self._spec_group = None
+        # per-group carry, NOT engine.last_stats: the bulk speculative
+        # route mutates that shared field from HTTP threads concurrently
+        self.spec_accepted += g.accepted_drafts
         for b, r in enumerate(reqs):
             n = min(int(out.lengths[b]), r.max_new)
             r.out_tokens.extend(out.tokens[b, :n].tolist())

@@ -615,3 +615,10 @@ class SpecGroup:
     top_k: jax.Array  # i32[B]
     top_p: jax.Array  # f32[B]
     rounds_run: int = 0
+
+    @property
+    def accepted_drafts(self) -> int:
+        """Total draft tokens the target accepted across this group's
+        rows — read off the group's OWN carry, not the engine's shared
+        last_stats (which any concurrent bulk generate() overwrites)."""
+        return int(np.asarray(self.state[8]).sum())

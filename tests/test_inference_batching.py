@@ -279,3 +279,23 @@ class TestSpeculativeRouting:
             assert eng.spec_served == 0
         finally:
             eng.stop()
+
+
+class TestSpecTelemetry:
+    def test_spec_counters_accumulate(self):
+        """spec_served counts members; spec_accepted accumulates the
+        groups' accepted draft tokens (a self-draft accepts ~all)."""
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        from kubeinfer_tpu.inference.speculative import SpeculativeEngine
+
+        spec = SpeculativeEngine(params, cfg, params, cfg, k=2)
+        eng = ContinuousEngine(
+            params, cfg, n_slots=2, cache_len=256, speculative=spec
+        ).start()
+        try:
+            eng.generate([5, 6, 7], max_new_tokens=8)
+            assert eng.spec_served == 1
+            assert eng.spec_accepted > 0  # self-draft: high acceptance
+        finally:
+            eng.stop()
