@@ -5,8 +5,8 @@ PY ?= python
 # Tests run on a forced virtual CPU mesh (tests/conftest.py); bench runs on
 # whatever JAX backend is live (real TPU chip if present).
 
-.PHONY: all native test test-fast test-e2e bench bench-quick bench-full lint \
-        run-manager run-agent docker-build clean
+.PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
+        bench-full lint run-manager run-agent docker-build clean
 
 all: native test-fast
 
@@ -24,6 +24,12 @@ test-fast: native
 
 test-e2e: native
 	$(PY) -m pytest tests/test_process_e2e.py tests/test_e2e_slice.py -q -x
+
+# Resilience tier: RetryPolicy/breaker units + deterministic
+# fault-injection scenarios (tests/test_chaos.py). Part of `test` too;
+# this target is the focused loop when iterating on failure handling.
+test-chaos:
+	$(PY) -m pytest tests/ -q -x -m chaos
 
 bench: native
 	$(PY) bench.py

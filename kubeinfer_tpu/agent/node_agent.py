@@ -18,6 +18,7 @@ stop ReplicaAgents for workload replicas the solver binds to its node.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import pathlib
@@ -37,9 +38,18 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
 )
 from kubeinfer_tpu.coordination.lease import LeaseManager
+from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
 
 log = logging.getLogger(__name__)
+
+# Store-edge failures a tick must survive: connection-level OSErrors
+# (which includes urllib's URLError/HTTPError and the circuit breaker's
+# fast-fail BreakerOpenError) and corrupt response payloads that
+# exhausted the store client's own retries. Domain errors (NotFound,
+# Conflict) are NOT here — they mean the store answered and the
+# specific handler owns the semantics.
+STORE_TRANSIENT = (OSError, json.JSONDecodeError)
 
 
 def model_cache_dir(root: str, model_repo: str) -> str:
@@ -96,11 +106,24 @@ class ReplicaAgent:
         )
 
     def _patch_replica(self, phase: str | None = None, pod_ip: str | None = None) -> None:
-        """Read-modify-write only this replica's runtime fields."""
+        """Read-modify-write only this replica's runtime fields.
+
+        Best-effort under a store outage: this runs on election-callback
+        and role threads, so a transport failure that survived the store
+        client's own retries is logged and dropped — the alternative
+        kills the election loop, which is the reference's documented
+        fragility (agent/__init__.py parity notes). A missed phase patch
+        is corrected by the controller's drift pass / the next role flip.
+        """
         for _ in range(5):
             try:
                 w = self._read_workload()
             except NotFoundError:
+                return
+            except STORE_TRANSIENT as e:
+                log.warning(
+                    "%s: replica patch skipped (store: %s)", self.identity, e
+                )
                 return
             for r in w.replicas:
                 if r.index == self._index:
@@ -119,6 +142,11 @@ class ReplicaAgent:
                 return
             except ConflictError:
                 continue
+            except STORE_TRANSIENT as e:
+                log.warning(
+                    "%s: replica patch dropped (store: %s)", self.identity, e
+                )
+                return
         log.warning("%s: replica patch kept conflicting", self.identity)
 
     def _resolve_coordinator(self) -> str:
@@ -378,6 +406,12 @@ class NodeAgent:
         # per-replica HBM demand for replicas THIS agent runs — the
         # framework-owned share of observed usage (see heartbeat)
         self._replica_mem: dict[tuple[str, str, int], int] = {}
+        # degraded-mode state (ISSUE 1): the last workload list the store
+        # served, and when the outage started (None = store reachable).
+        # During an outage ticks reconcile against this snapshot — bound
+        # replicas keep running — and staleness is exported on /metrics.
+        self._last_workloads: list[Workload] = []
+        self._stale_since: float | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -404,6 +438,9 @@ class NodeAgent:
         solve then evicts them, the next heartbeat frees the capacity, and
         placements oscillate.
 
+        Transient store failures propagate to ``tick``, which degrades
+        (stale heartbeat, cached bindings) instead of aborting the tick.
+
         With an HBM observer configured, EXTERNAL memory usage does reach
         the solver (r2 verdict weak #5: a node half-eaten by a rogue
         process must attract proportionally fewer replicas): external =
@@ -411,6 +448,7 @@ class NodeAgent:
         stays reported as free, preserving the anti-oscillation rule
         above), and the advertised free memory shrinks by exactly that.
         """
+        faultpoints.fire("agent.heartbeat", key=self.node_name)
         mem_free = self._mem_capacity
         if self._observe_memory is not None:
             obs = self._observe_memory()
@@ -489,16 +527,65 @@ class NodeAgent:
                 )
                 self._agents[key] = agent
                 self._replica_mem[key] = w.gpu_memory_bytes
-                agent.start()
+                try:
+                    agent.start()
+                except STORE_TRANSIENT as e:
+                    # start() re-reads the workload record; a store blip
+                    # here must not abort the whole sync pass. Drop the
+                    # agent so the next tick re-creates it cleanly.
+                    log.warning(
+                        "%s: replica %s start deferred (store: %s)",
+                        self.node_name, key, e,
+                    )
+                    agent.stop()
+                    del self._agents[key]
+                    self._replica_mem.pop(key, None)
 
     # -- loop ---------------------------------------------------------------
 
     def tick(self) -> None:
-        workloads = [
-            Workload.from_dict(d) for d in self._store.list(Workload.KIND)
-        ]
+        """One reconcile+heartbeat pass, degrading under a store outage.
+
+        A transient store failure (reset burst, 503 storm, breaker open)
+        must not abort the tick: bound replicas keep running against the
+        LAST-KNOWN workload list, and the outage is made observable —
+        ``kubeinfer_agent_store_stale_seconds`` rises until the store
+        answers again, ``kubeinfer_agent_degraded_ticks_total`` counts
+        the ticks served from cache. The heartbeat is still attempted
+        each tick (reads and writes can fail independently under partial
+        faults) and its own transient failures are swallowed the same
+        way. Recovery is automatic: the first successful list refreshes
+        the cache and zeroes the staleness gauge.
+        """
+        degraded = False
+        try:
+            workloads = [
+                Workload.from_dict(d) for d in self._store.list(Workload.KIND)
+            ]
+            self._last_workloads = workloads
+        except STORE_TRANSIENT as e:
+            degraded = True
+            workloads = self._last_workloads
+            log.warning(
+                "node agent %s: store unreachable (%s); reconciling "
+                "against last-known bindings", self.node_name, e,
+            )
         self.sync_replicas(workloads)
-        self.heartbeat()
+        try:
+            self.heartbeat()
+        except STORE_TRANSIENT:
+            degraded = True
+        if degraded:
+            metrics.agent_degraded_ticks_total.inc(self.node_name)
+        if degraded and self._stale_since is None:
+            self._stale_since = self._clock.now()
+        elif not degraded:
+            self._stale_since = None
+        metrics.agent_store_stale_seconds.set(
+            self.node_name,
+            0.0 if self._stale_since is None
+            else self._clock.now() - self._stale_since,
+        )
 
     def run(self) -> None:
         while not self._stop.is_set():

@@ -134,10 +134,17 @@ class RuntimeServer:
         The cancel hook matters for role teardown: without it a role
         restart would block behind a (possibly minutes-long) health wait
         while the old runtime still owns the serving port.
+
+        The poll loop IS this edge's retry policy (fixed 0.5s cadence
+        under an overall deadline — backoff would only delay readiness);
+        the ``runtime.health`` fault point injects probe failures so
+        chaos tests can pin the slow-start and flapping-health paths.
         """
         import time
         import urllib.error
         import urllib.request
+
+        from kubeinfer_tpu.resilience import faultpoints
 
         if timeout_s is None:
             timeout_s = self.config.health_timeout_s
@@ -155,6 +162,9 @@ class RuntimeServer:
                     "before becoming healthy"
                 )
             try:
+                # injected faults (error/latency/blackhole) are handled
+                # exactly like real probe failures below
+                faultpoints.fire("runtime.health", key=url)
                 with urllib.request.urlopen(url, timeout=2) as resp:
                     if resp.status == 200:
                         return True

@@ -29,6 +29,8 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
+from kubeinfer_tpu.resilience import RetryPolicy, faultpoints
+
 # Written into the model dir after a FULLY verified sync; its presence is
 # the only thing that distinguishes "complete local copy" from "partial
 # sync that happens to contain whole files" (each file lands atomically,
@@ -105,6 +107,7 @@ def _open(
 
 def fetch_file_list(endpoint: str, ca_file: str = "") -> list[FileEntry]:
     """GET /models → FileEntry list (follower.go:83-110 parity + metadata)."""
+    faultpoints.fire("transfer.fetch", key="/models")
     conn, base = _open(endpoint, ca_file)
     try:
         conn.request("GET", base + "/models")
@@ -130,6 +133,7 @@ def download_file(
     part = dest.with_name(dest.name + ".part")
 
     offset = part.stat().st_size if part.exists() else 0
+    faultpoints.fire("transfer.fetch", key=rel_path)
     conn, base = _open(endpoint, ca_file)
     transferred = 0
     expected_total = -1
@@ -183,6 +187,13 @@ def download_file(
     return transferred
 
 
+# What one sync attempt may die of and the next attempt can heal:
+# transfer protocol errors (bad status, size/checksum mismatch — possibly
+# a mid-failover coordinator), connection-level OSErrors, and HTTP
+# protocol breakage (short reads, torn chunked bodies).
+_SYNC_TRANSIENT = (TransferError, OSError, http.client.HTTPException)
+
+
 def sync_model(
     endpoint,
     dest_dir: str,
@@ -198,50 +209,68 @@ def sync_model(
     mid-transfer coordinator death (connection error / short read) resumes
     against the NEW coordinator after failover, continuing from the .part
     file's size.
+
+    Retry scheduling rides the shared ``RetryPolicy`` (resilience/) —
+    formerly a bespoke fixed-delay loop here. ``retry_delay_s`` is now
+    the backoff BASE (full jitter, exponential growth capped at 8×), so
+    a fleet of followers re-syncing after a coordinator death no longer
+    hammers the successor in lockstep. Attempt counting is unchanged:
+    ``attempts`` total tries, ``sleep`` injectable for tests.
     """
     resolve = endpoint if callable(endpoint) else (lambda: endpoint)
-    last: Exception | None = None
-    for attempt in range(attempts):
-        ep = ""
-        try:
-            ep = resolve()
-            if not ep:
-                raise TransferError("no coordinator endpoint available")
-            entries = fetch_file_list(ep, ca_file=ca_file)
-            # Invalidate the completion marker BEFORE any mutation: a
-            # re-sync that dies halfway (file deleted on checksum
-            # mismatch, download failed) must not leave a stale marker
-            # vouching for a mixed-version dir.
-            (pathlib.Path(dest_dir) / SYNC_MARKER).unlink(missing_ok=True)
-            for entry in entries:
-                dest = pathlib.Path(dest_dir) / entry.path
-                if dest.exists():
-                    # rename is the completion marker, but the CONTENT may
-                    # still be stale (coordinator changed across failover,
-                    # possibly at the same size): trust only a checksum
-                    # match when the listing carries one.
-                    if not entry.sha256 or _local_sha256(dest) == entry.sha256:
-                        continue
-                    dest.unlink()
-                download_file(ep, entry.path, dest_dir, ca_file=ca_file)
-                if entry.sha256:
-                    got = _local_sha256(dest)
-                    if got != entry.sha256:
-                        dest.unlink(missing_ok=True)
-                        raise TransferError(
-                            f"{entry.path}: checksum mismatch after download "
-                            f"(got {got[:12]}…, want {entry.sha256[:12]}…)"
-                        )
-            marker = pathlib.Path(dest_dir) / SYNC_MARKER
-            marker.write_text(json.dumps({
-                "files": [
-                    {"path": e.path, "size": e.size, "sha256": e.sha256}
-                    for e in entries
-                ],
-            }))
-            return [e.path for e in entries]
-        except (TransferError, OSError, http.client.HTTPException) as e:
-            last = e
-            if attempt < attempts - 1:
-                sleep(retry_delay_s)
-    raise TransferError(f"sync from {ep or endpoint} failed after {attempts} attempts: {last}")
+    last_ep: list[str] = [""]
+
+    def attempt_once() -> list[str]:
+        ep = resolve()
+        last_ep[0] = ep
+        if not ep:
+            raise TransferError("no coordinator endpoint available")
+        entries = fetch_file_list(ep, ca_file=ca_file)
+        # Invalidate the completion marker BEFORE any mutation: a
+        # re-sync that dies halfway (file deleted on checksum
+        # mismatch, download failed) must not leave a stale marker
+        # vouching for a mixed-version dir.
+        (pathlib.Path(dest_dir) / SYNC_MARKER).unlink(missing_ok=True)
+        for entry in entries:
+            dest = pathlib.Path(dest_dir) / entry.path
+            if dest.exists():
+                # rename is the completion marker, but the CONTENT may
+                # still be stale (coordinator changed across failover,
+                # possibly at the same size): trust only a checksum
+                # match when the listing carries one.
+                if not entry.sha256 or _local_sha256(dest) == entry.sha256:
+                    continue
+                dest.unlink()
+            download_file(ep, entry.path, dest_dir, ca_file=ca_file)
+            if entry.sha256:
+                got = _local_sha256(dest)
+                if got != entry.sha256:
+                    dest.unlink(missing_ok=True)
+                    raise TransferError(
+                        f"{entry.path}: checksum mismatch after download "
+                        f"(got {got[:12]}…, want {entry.sha256[:12]}…)"
+                    )
+        marker = pathlib.Path(dest_dir) / SYNC_MARKER
+        marker.write_text(json.dumps({
+            "files": [
+                {"path": e.path, "size": e.size, "sha256": e.sha256}
+                for e in entries
+            ],
+        }))
+        return [e.path for e in entries]
+
+    policy = RetryPolicy(
+        max_attempts=max(1, attempts),
+        base_delay_s=retry_delay_s,
+        max_delay_s=retry_delay_s * 8,
+        deadline_s=0,  # a model sync is minutes-long by nature; the
+        # attempt budget, not wall time, bounds it
+        classify=lambda e: isinstance(e, _SYNC_TRANSIENT),
+    )
+    try:
+        return policy.call(attempt_once, edge="transfer.sync", sleep=sleep)
+    except _SYNC_TRANSIENT as e:
+        raise TransferError(
+            f"sync from {last_ep[0] or endpoint} failed after "
+            f"{attempts} attempts: {e}"
+        ) from e

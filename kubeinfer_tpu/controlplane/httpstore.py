@@ -51,6 +51,13 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
     WatchEvent,
 )
+from kubeinfer_tpu.resilience import (
+    CircuitBreaker,
+    RetryPolicy,
+    connect_failure,
+    transient_http,
+)
+from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.httpbase import (
     BaseEndpointHandler,
     client_ssl_context,
@@ -140,7 +147,9 @@ class StoreServer:
                         timeout = min(float(q.get("timeout", ["30"])[0]), 300.0)
                         kind = q.get("kind", [None])[0]
                         ns = q.get("namespace", [None])[0]
-                        evs, rv = server._poll_events(since, timeout, kind, ns)
+                        evs, rv, store_rv, oldest = server._poll_events(
+                            since, timeout, kind, ns
+                        )
                         self._send(200, {
                             "resourceVersion": rv,
                             # the store's ACTUAL counter, unclamped by
@@ -149,15 +158,12 @@ class StoreServer:
                             # follower compares this against its local
                             # cursor to detect a primary whose history
                             # is BEHIND it (restart with fresh state)
-                            "storeRv": server._store._rv,
+                            "storeRv": store_rv,
                             # earliest rv still in the event ring (0 =
                             # empty): a follower whose `since` predates
                             # it cannot prove continuity and must full-
                             # resync via /dump
-                            "oldestEvent": (
-                                server._events[0].resource_version
-                                if server._events else 0
-                            ),
+                            "oldestEvent": oldest,
                             "events": [
                                 {
                                     "type": e.type, "kind": e.kind,
@@ -264,6 +270,17 @@ class StoreServer:
         with self._events_cond:
             self._events_cond.notify_all()
 
+    def abort(self) -> None:
+        """Release the bound socket and watch for a server that was
+        constructed (socket bound) but never ``start()``ed — e.g. a
+        promotion that lost the race to ``stop()``. ``shutdown()`` would
+        deadlock here: BaseServer.shutdown blocks on serve_forever's
+        exit handshake, and serve_forever never ran."""
+        self._httpd.server_close()
+        self._watch.close()
+        with self._events_cond:
+            self._events_cond.notify_all()
+
     # -- admission --------------------------------------------------------
 
     @staticmethod
@@ -296,7 +313,23 @@ class StoreServer:
 
     def _poll_events(
         self, since: int, timeout: float, kind: str | None, ns: str | None
-    ) -> tuple[list[WatchEvent], int]:
+    ) -> tuple[list[WatchEvent], int, int, int]:
+        """One long-poll page plus the gap markers, snapshotted together.
+
+        Returns (events, watch-cursor rv, storeRv, oldestEvent). The
+        markers are read under ``_events_cond`` IN THE SAME critical
+        section that collects the events (ADVICE r5): an unlocked
+        ``oldestEvent`` read racing the pump could otherwise pair a
+        just-advanced ring head with an events page collected before the
+        advance. (Direction analysis says that race only errs toward a
+        spurious follower resync — oldest rises monotonically — but the
+        snapshot makes the page self-consistent instead of leaning on
+        that reasoning.) ``storeRv`` reads the store's counter, which may
+        run AHEAD of the ring (writes land in the store before the pump
+        republishes them); ahead is the safe direction for its one
+        consumer — the behind-primary check in replica.py compares
+        ``storeRv < follower cursor``.
+        """
         def matching() -> list[WatchEvent]:
             # The ring is rv-ordered and pollers sit near the tip: scan
             # from the right and stop at the first already-seen event,
@@ -318,7 +351,8 @@ class StoreServer:
                 self._events_cond.wait(timeout)
                 evs = matching()
             rv = self._events[-1].resource_version if self._events else since
-            return evs, max(rv, since)
+            oldest = self._events[0].resource_version if self._events else 0
+            return evs, max(rv, since), self._store._rv, oldest
 
 
 class RemoteStore:
@@ -327,34 +361,93 @@ class RemoteStore:
     Drop-in for ``Store``: agents, controllers, and the CLI take whichever
     they are handed (the reference equivalently swaps in-cluster and
     kubeconfig clients, cmd/agent/main.go:56 vs _archive/election).
+
+    Resilience (ISSUE 1): every request runs under a ``RetryPolicy``
+    with idempotency-aware classification — GET/LIST/watch pages retry
+    any transient transport failure (including a torn/corrupt response
+    body); PUT/POST/DELETE retry ONLY connect-level failures, where the
+    request provably never reached the server. A retried mutation that
+    actually landed is caught by the protocol itself: creates surface
+    AlreadyExistsError, CAS updates surface ConflictError, and every
+    caller already treats both as "re-read and retry". A shared
+    ``CircuitBreaker`` fails calls fast (``BreakerOpenError``, an
+    OSError) during a sustained outage so high-frequency callers
+    (heartbeat ticks) degrade in microseconds instead of burning a full
+    retry schedule per tick.
     """
+
+    # A store round-trip is local-network cheap; a short schedule rides
+    # out a restart/failover without stretching anyone's failure
+    # detector (deadline_s=0: the per-call cap is attempts × timeout,
+    # and long-poll callers own their windows explicitly).
+    _GET_POLICY = RetryPolicy(
+        max_attempts=4, base_delay_s=0.05, max_delay_s=1.0, deadline_s=0,
+        classify=transient_http,
+    )
+    _MUTATE_POLICY = RetryPolicy(
+        max_attempts=4, base_delay_s=0.05, max_delay_s=1.0, deadline_s=0,
+        classify=connect_failure,
+    )
+    # watch_page: NO retries and NO breaker accounting — the replica's
+    # follow loop is itself a failure detector (failover_grace_s counts
+    # consecutive failed polls), so a resilience layer underneath it
+    # would stretch exactly the detection latency it calibrates.
+    _RAW_POLICY = RetryPolicy(max_attempts=1, deadline_s=0)
 
     def __init__(self, base_url: str, token: str = "",
                  request_timeout_s: float = 35.0,
-                 ca_file: str = "") -> None:
+                 ca_file: str = "",
+                 retry: bool = True,
+                 breaker: CircuitBreaker | None = None) -> None:
         self.base_url = base_url.rstrip("/")
         self._token = token
         self._timeout = request_timeout_s
         # pinned CA bundle for https stores (None -> system default
         # verification for https URLs; ignored for http)
         self._ssl_ctx = client_ssl_context(ca_file)
+        self._retry = retry
+        # one breaker per client: all methods share the same TCP edge.
+        # Tests/tools pass breaker=CircuitBreaker(failure_threshold=...)
+        # to tune trip/reset; retry=False restores the seed's
+        # single-attempt behavior (e.g. probes that time-box themselves).
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            edge="store", failure_threshold=5, reset_timeout_s=1.0,
+        )
 
     # -- plumbing ---------------------------------------------------------
 
     def _req(self, method: str, path: str, body: dict | None = None,
-             timeout: float | None = None) -> Any:
+             timeout: float | None = None,
+             policy: RetryPolicy | None = None,
+             use_breaker: bool = True) -> Any:
+        if policy is None:
+            policy = self._GET_POLICY if method == "GET" else self._MUTATE_POLICY
+        if not self._retry:
+            policy = self._RAW_POLICY
+        return policy.call(
+            lambda: self._req_once(method, path, body, timeout),
+            edge="store",
+            breaker=self._breaker if (use_breaker and self._retry) else None,
+        )
+
+    def _req_once(self, method: str, path: str, body: dict | None,
+                  timeout: float | None) -> Any:
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
+        faultpoints.fire("store.request", key=f"{method} {path}")
         try:
             with urllib.request.urlopen(
                 req, timeout=timeout or self._timeout,
                 context=self._ssl_ctx,
             ) as resp:
-                return json.loads(resp.read() or b"null")
+                raw = faultpoints.mangle(
+                    "store.request", resp.read(), key=f"{method} {path}"
+                )
+                return json.loads(raw or b"null")
         except urllib.error.HTTPError as e:
             payload = {}
             try:
@@ -377,7 +470,11 @@ class RemoteStore:
 
     def healthz(self) -> bool:
         try:
-            return self._req("GET", "/healthz")["status"] == "ok"
+            # single attempt, no breaker: health probes are their own
+            # retry loop, and a probe must see the edge's real state
+            return self._req(
+                "GET", "/healthz", policy=self._RAW_POLICY, use_breaker=False
+            )["status"] == "ok"
         except Exception:
             return False
 
@@ -446,6 +543,10 @@ class RemoteStore:
             # client-side timeout is the blackhole-failure detector, so
             # it must not dwarf the replica's failover grace
             timeout=timeout + 2.0,
+            # no retry/breaker: the replica's grace accounting counts
+            # RAW poll failures (see _RAW_POLICY note above)
+            policy=self._RAW_POLICY,
+            use_breaker=False,
         )
 
 
@@ -468,8 +569,16 @@ class RemoteWatch:
         if self._ns is not None:
             q["namespace"] = self._ns
         path = "/watch?" + urllib.parse.urlencode(q)
-        # network timeout must outlive the server-side long-poll window
-        resp = self._store._req("GET", path, timeout=timeout + 10.0)
+        # network timeout must outlive the server-side long-poll window;
+        # the deadline caps the whole retry schedule at roughly one
+        # extra window so next_event() stays responsive to close()
+        resp = self._store._req(
+            "GET", path, timeout=timeout + 10.0,
+            policy=RetryPolicy(
+                max_attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+                deadline_s=timeout + 15.0, classify=transient_http,
+            ),
+        )
         self._since = max(self._since, resp["resourceVersion"])
         for e in resp["events"]:
             self._pending.append(
@@ -487,15 +596,17 @@ class RemoteWatch:
         if not self._pending:
             try:
                 self._fetch(timeout if timeout is not None else 30.0)
-            except (OSError, NotFoundError):
-                return None  # transient; caller's periodic tick covers it
+            except (OSError, NotFoundError, json.JSONDecodeError):
+                # transient (incl. a corrupt page that exhausted its
+                # retries); caller's periodic tick covers it
+                return None
         return self._pending.popleft() if self._pending else None
 
     def drain(self) -> list[WatchEvent]:
         if not self._closed and not self._pending:
             try:
                 self._fetch(timeout=0.0)
-            except (OSError, NotFoundError):
+            except (OSError, NotFoundError, json.JSONDecodeError):
                 pass
         out = list(self._pending)
         self._pending.clear()

@@ -27,6 +27,7 @@ Differences (deliberate):
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from dataclasses import dataclass
@@ -38,7 +39,13 @@ from kubeinfer_tpu.controlplane.store import (
     NotFoundError,
     Store,
 )
+from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+# Store failures a renew tick must survive (see node_agent.py
+# STORE_TRANSIENT: OSError covers urllib errors and the breaker's
+# fast-fail; JSONDecodeError is a torn payload past its retries).
+_TRANSIENT = (OSError, json.JSONDecodeError)
 
 log = logging.getLogger(__name__)
 
@@ -127,13 +134,29 @@ class LeaseManager:
     # -- state machine (election.go:47-69) --------------------------------
 
     def try_acquire_or_renew(self) -> bool:
+        """One election tick. Store-transport failures report NOT-held
+        (never raise): a partitioned participant must degrade to
+        follower — its lease TTL-expires and a reachable peer steals it,
+        which IS the failover the protocol is built around. Retrying
+        inside the tick is deliberately left to the store client
+        (RemoteStore's policy); stacking another schedule here would
+        stretch the tick past the retry interval and thin the renew
+        margin the module docstring calls out.
+        """
         now = self._clock.now()
         try:
+            faultpoints.fire("lease.renew", key=self.identity)
             lease = Lease.from_dict(
                 self._store.get(LEASE_KIND, self._lease_name, self._namespace)
             )
         except NotFoundError:
             return self._create_lease(now)
+        except _TRANSIENT as e:
+            log.warning(
+                "%s: lease %s tick failed (store: %s); degrading to "
+                "follower", self.identity, self._lease_name, e,
+            )
+            return False
         if lease.holder == self.identity:
             return self._renew_lease(lease, now)
         if self._expired(lease, now):
@@ -160,6 +183,11 @@ class LeaseManager:
             return True
         except AlreadyExistsError:
             return False
+        except _TRANSIENT:
+            # a create that LANDED before the failure is indistinguishable
+            # from one that didn't; report not-held — if we do hold it,
+            # the next tick's read sees our identity and renews
+            return False
 
     def _renew_lease(self, lease: Lease, now: float) -> bool:
         # election.go:107-120. A failed CAS means someone stole it after our
@@ -169,6 +197,11 @@ class LeaseManager:
             self._store.update(LEASE_KIND, lease.to_dict())
             return True
         except (ConflictError, NotFoundError):
+            return False
+        except _TRANSIENT:
+            # transport failure ≠ lost lease, but the safe report is
+            # not-held: a leader that can't renew must stand down before
+            # a peer steals the expired lease (split-brain otherwise)
             return False
 
     def _acquire_lease(self, lease: Lease, now: float) -> bool:
@@ -182,7 +215,7 @@ class LeaseManager:
             self._store.update(LEASE_KIND, lease.to_dict())
             log.info("%s stole lease %s", self.identity, self._lease_name)
             return True
-        except (ConflictError, NotFoundError):
+        except (ConflictError, NotFoundError, *_TRANSIENT):
             return False
 
     # -- public state ------------------------------------------------------
@@ -198,6 +231,11 @@ class LeaseManager:
                 self._store.get(LEASE_KIND, self._lease_name, self._namespace)
             )
         except NotFoundError:
+            return ""
+        except _TRANSIENT:
+            # unknown ≠ none, but callers treat "" as "retry later"
+            # (follower sync loops re-resolve each attempt) — the honest
+            # degraded answer during a store outage
             return ""
         return lease.holder
 

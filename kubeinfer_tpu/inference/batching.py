@@ -287,7 +287,20 @@ class _Request:
 class ContinuousEngine:
     """Slot-scheduled generation: submit() from any thread; a single
     scheduler thread admits requests into free slots and steps the
-    shared decode batch."""
+    shared decode batch.
+
+    Cold-compile stall (ADVICE r5): when ``_place`` forms a draft
+    group, ``speculative.start_group`` runs ON the scheduler thread,
+    and the first group with a new ``(B, prompt bucket, cache_len)``
+    shape pays the full jit compile there — potentially tens of
+    seconds on which EVERY in-flight slot request also stalls (no
+    decode steps run while the scheduler is inside the compile). The
+    same applies to the first prefill of each prompt bucket on the
+    slot path. Deployments that care should call ``prewarm_spec()``
+    (and/or issue a throwaway generate per bucket) before serving
+    traffic; the per-shape compile caches are process-global, so one
+    warmup covers all subsequent groups of that shape.
+    """
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  n_slots: int = 8, cache_len: int = 1024,
@@ -374,6 +387,36 @@ class ContinuousEngine:
         if req.failed:
             raise RuntimeError(req.failed)
         return req.out_tokens
+
+    def prewarm_spec(self, group_sizes: tuple[int, ...] = (1,),
+                     prompt_len: int = 8, max_new_tokens: int = 8,
+                     sampled: bool = False) -> int:
+        """Compile the draft-group path for the given group sizes BEFORE
+        traffic arrives (class docstring: the first group of a new shape
+        otherwise compiles on the scheduler thread, stalling every
+        in-flight slot request behind it). Runs ``start_group`` plus one
+        ``step_group`` round per size on dummy prompts and discards the
+        results; the jit caches are process-global, so one warm covers
+        all later groups of that ``(B, bucket, cache_len)`` shape.
+        ``sampled=True`` warms the sampled trace instead of the greedy
+        one (the greedy/sampled split is a static trace flag — they
+        compile separately). Call before serving; returns the number of
+        shapes warmed. No-op without a speculative engine."""
+        if self.speculative is None:
+            return 0
+        warmed = 0
+        for b in group_sizes:
+            b = int(b)
+            if b < 1 or not self.speculative.fits(prompt_len, max_new_tokens):
+                continue
+            g = self.speculative.start_group(
+                [[1] * prompt_len] * b,
+                max_new_tokens=max_new_tokens,
+                temperatures=0.7 if sampled else 0.0,
+            )
+            self.speculative.step_group(g)
+            warmed += 1
+        return warmed
 
     def start(self) -> "ContinuousEngine":
         self._thread = threading.Thread(
@@ -480,11 +523,18 @@ class ContinuousEngine:
         Joinable: same MODE as the head (greedy with greedy, sampled
         with sampled — the rejection correction and warp knobs are
         per-row, r4 item 5, but the greedy/sampled split is a static
-        trace flag), no repetition penalty, same eos id, and every
-        member still fits the draft cache at the group's max_new
-        high-water mark. The first non-joinable request is returned as
-        a holdover for slot admission — draining must not reorder it
-        behind later arrivals.
+        trace flag), no repetition penalty, same eos id, equal SEED for
+        sampled joins, and every member still fits the draft cache at
+        the group's max_new high-water mark. The seed requirement is a
+        reproducibility guard (ADVICE r5): the group's key stream is
+        seeded by the HEAD request only (``_start_spec_group`` passes
+        ``first.seed``), so a sampled request joining under a different
+        seed would silently sample from the head's stream — same prompt
+        + seed + params would then give different tokens depending on
+        what else was in flight. Greedy rows draw no noise, so their
+        seeds are irrelevant. The first non-joinable request is
+        returned as a holdover for slot admission — draining must not
+        reorder it behind later arrivals.
         """
         group = [first]
         gmax = first.max_new
@@ -502,6 +552,7 @@ class ContinuousEngine:
             if (
                 nxt.rep_penalty == 1.0
                 and (nxt.temperature > 0) == head_sampled
+                and (not head_sampled or nxt.seed == first.seed)
                 and nxt.eos_id == first.eos_id
                 and all(
                     self.speculative.fits(len(m.prompt), cand_max)

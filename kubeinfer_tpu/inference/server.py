@@ -21,6 +21,15 @@ what the tests and the e2e slice use). ``--random-init`` serves a
 randomly initialized preset config — the demo/e2e mode that needs no
 weights and no network, the role the reference's vllm-mock image plays,
 except it really generates.
+
+Reproducibility contract: a completion is a deterministic function of
+(prompt, seed, sampling params) — independent of what else is in
+flight. Greedy requests are trivially so; sampled requests hold it
+because the batcher's draft groups only join sampled requests with
+EQUAL seeds (batching.py _drain_spec_group — the group key stream is
+seeded by the head request, so a different-seed join would silently
+sample from the head's stream), and slot-path sampling keys are
+per-slot, derived from each request's own seed.
 """
 
 from __future__ import annotations
@@ -406,6 +415,12 @@ def main(argv: list[str] | None = None) -> int:
                         "target's vocabulary")
     p.add_argument("--speculation-depth", type=int, default=4,
                    help="draft tokens proposed per verification round")
+    p.add_argument("--prewarm-spec", default="",
+                   help="comma-separated draft-group sizes to compile "
+                        "before serving (e.g. '1,2,4'); without it the "
+                        "first group of each size compiles on the "
+                        "scheduler thread, stalling in-flight requests "
+                        "(batching.py ContinuousEngine docstring)")
     p.add_argument("--tls-cert-file", default="",
                    help="serve completions over TLS (PEM cert; key via "
                         "--tls-key-file)")
@@ -503,7 +518,16 @@ def main(argv: list[str] | None = None) -> int:
             params, cfg, n_slots=args.batch_slots,
             cache_len=min(max_cache, 4096),
             speculative=speculative,
-        ).start()
+        )
+        if args.prewarm_spec and speculative is not None:
+            sizes = tuple(
+                int(s) for s in args.prewarm_spec.split(",") if s.strip()
+            )
+            t0 = time.monotonic()
+            n = continuous.prewarm_spec(group_sizes=sizes)
+            log.info("prewarmed %d draft-group shapes in %.1fs",
+                     n, time.monotonic() - t0)
+        continuous.start()
     srv = InferenceServer(
         engine, model_id=args.model, tokenizer=tokenizer,
         host=args.host, port=args.port, continuous=continuous,

@@ -154,6 +154,12 @@ class Manager:
         self._ready = threading.Event()
         self._is_leader = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Serializes replica promotion against stop(): promotion runs on
+        # the replica thread and publishes store/store_server/_local_store,
+        # which stop() tears down — without mutual exclusion a stop racing
+        # a promotion can leak a freshly bound StoreServer (socket held
+        # forever) or close a store mid-publication.
+        self._promote_mu = threading.Lock()
 
         self._replica = None
         if cfg.store_connect:
@@ -329,26 +335,61 @@ class Manager:
         following. On success the manager becomes a full primary:
         hosted store, election (the dead leader's replicated lease must
         TTL-expire before this manager wins — CAS continuity makes that
-        steal sound), reconcile."""
-        try:
-            server = self._host_store_server(self._replica.store)
-        except OSError as e:
-            log.warning("promotion bind lost (%s); resuming follow", e)
-            return False
-        self._local_store = self._replica.store
-        self.store = self._local_store
-        self.store_server = server.start()
-        log.warning(
-            "promoted: serving replicated store on %s (rv continuity "
-            "from the dead primary)", server.address,
-        )
-        self.controller = self._make_controller()
-        if self.cfg.leader_elect:
-            self._start_election()
-        else:
-            self._is_leader.set()
-            self._start_controller()
-        return True
+        steal sound), reconcile.
+
+        Runs entirely under ``_promote_mu`` so stop() can't interleave
+        with the bind/publish sequence. ``_stop`` is checked both before
+        AND after the bind: stop() sets the flag without the lock (it
+        must — taking it first would deadlock against this very method
+        via the replica-thread join), so the flag can flip while we hold
+        the mutex. A dying manager must release the frontend it just
+        won, not half-promote."""
+        with self._promote_mu:
+            if self._stop.is_set():
+                return False
+            try:
+                server = self._host_store_server(self._replica.store)
+            except OSError as e:
+                log.warning("promotion bind lost (%s); resuming follow", e)
+                return False
+            if self._stop.is_set():
+                # bound but never started: abort(), not shutdown() —
+                # shutdown would block on a serve_forever that never ran
+                server.abort()
+                return False
+            prev_store = self.store
+            started = False
+            try:
+                self.store_server = server.start()
+                started = True
+                self._local_store = self._replica.store
+                self.store = self._local_store
+                self.controller = self._make_controller()
+                if self.cfg.leader_elect:
+                    self._start_election()
+                else:
+                    self._is_leader.set()
+                    self._start_controller()
+            except Exception:
+                log.exception(
+                    "promotion failed after bind; releasing the frontend"
+                )
+                if started:
+                    server.shutdown()
+                else:
+                    server.abort()
+                self.store_server = None
+                self.store = prev_store
+                self._is_leader.clear()
+                if self._lease is not None:
+                    self._lease.stop()
+                    self._lease = None
+                return False
+            log.warning(
+                "promoted: serving replicated store on %s (rv continuity "
+                "from the dead primary)", server.address,
+            )
+            return True
 
     def _on_elected(self) -> None:
         log.info("manager elected leader")
@@ -395,6 +436,14 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        # Handshake with an in-flight promotion: after this acquire,
+        # either the promotion published its server/lease/threads (torn
+        # down below) or it observed _stop and unwound itself. _stop MUST
+        # be set before acquiring and the lock released before the joins
+        # below — _replica.stop() joins the replica thread, which may be
+        # inside _promote_replica waiting for this same lock.
+        with self._promote_mu:
+            pass
         self._is_leader.clear()
         if self._lease is not None:
             self._lease.stop()

@@ -13,13 +13,20 @@ from kubeinfer_tpu.metrics.registry import (
     Histogram,
     Registry,
     REGISTRY,
+    agent_degraded_ticks_total,
+    agent_store_stale_seconds,
     auction_fallback_total,
+    breaker_state,
+    breaker_transitions_total,
     coordinator_elections_total,
+    fault_injections_total,
     llmservice_ready_replicas,
     llmservice_total,
     model_download_duration_seconds,
     reconcile_duration_seconds,
     reconcile_total,
+    retries_exhausted_total,
+    retry_attempts_total,
     solve_duration_seconds,
     solve_placement_ratio,
     solve_problem_size,
@@ -31,13 +38,20 @@ __all__ = [
     "Histogram",
     "Registry",
     "REGISTRY",
+    "agent_degraded_ticks_total",
+    "agent_store_stale_seconds",
     "auction_fallback_total",
+    "breaker_state",
+    "breaker_transitions_total",
     "coordinator_elections_total",
+    "fault_injections_total",
     "llmservice_ready_replicas",
     "llmservice_total",
     "model_download_duration_seconds",
     "reconcile_duration_seconds",
     "reconcile_total",
+    "retries_exhausted_total",
+    "retry_attempts_total",
     "solve_duration_seconds",
     "solve_placement_ratio",
     "solve_problem_size",

@@ -248,3 +248,45 @@ auction_fallback_total = Counter(
     "not a one-replica-per-node instance (auction would silently "
     "under-place)",
 )
+
+# --- resilience observability (resilience/, ISSUE 1) ------------------------
+# `edge` names a network edge from docs/ARCHITECTURE.md's failure-handling
+# catalogue (store, lease, transfer.sync, ...); `point` names a fault
+# point (resilience/faultpoints.py). Degradation must be visible on
+# /metrics, never silent.
+
+retry_attempts_total = Counter(
+    "kubeinfer_retry_attempts_total",
+    "Retried attempts per network edge (beyond each call's first try)",
+    labels=("edge",),
+)
+retries_exhausted_total = Counter(
+    "kubeinfer_retries_exhausted_total",
+    "Calls that failed after exhausting their retry budget",
+    labels=("edge",),
+)
+breaker_transitions_total = Counter(
+    "kubeinfer_breaker_transitions_total",
+    "Circuit-breaker state transitions",
+    labels=("edge", "to"),  # to: closed | open | half-open
+)
+breaker_state = Gauge(
+    "kubeinfer_breaker_state",
+    "Circuit-breaker state (0=closed, 1=open, 2=half-open)",
+    labels=("edge",),
+)
+fault_injections_total = Counter(
+    "kubeinfer_fault_injections_total",
+    "Faults fired by the chaos harness (resilience/faultpoints.py)",
+    labels=("point", "mode"),
+)
+agent_degraded_ticks_total = Counter(
+    "kubeinfer_agent_degraded_ticks_total",
+    "Node-agent ticks served from last-known bindings during a store outage",
+    labels=("node",),
+)
+agent_store_stale_seconds = Gauge(
+    "kubeinfer_agent_store_stale_seconds",
+    "Seconds since the node agent last reached the store (0 = fresh)",
+    labels=("node",),
+)

@@ -75,6 +75,9 @@ _COMPONENT_BY_PREFIX = (
      "solver"),
     (("test_inference", "test_flash", "test_sampling", "test_speculative"),
      "inference"),
+    # resilience layer + fault-injection scenarios (`make test-chaos`);
+    # pure controlplane work — runs under the same virtual CPU mesh
+    (("test_chaos", "test_resilience"), "chaos"),
 )
 
 

@@ -1,0 +1,393 @@
+"""Deterministic chaos scenarios: seeded fault injection end-to-end.
+
+Each scenario arms named fault points (resilience/faultpoints.py) against
+REAL components — live StoreServer, node-agent thread, model transfer,
+replica standby, lease election — and asserts both the degraded behavior
+and its observability (metrics deltas; scenario A scrapes a real
+/metrics endpoint over HTTP). Faults come from a seeded registry RNG, so
+every run injects the identical sequence; test_harness_determinism pins
+that property directly.
+
+Everything here is pure control-plane work (no jit compiles); the suite
+still runs under the forced 8-device virtual CPU mesh like every tier.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeinfer_tpu import metrics
+from kubeinfer_tpu.api.workload import NodeState, Workload
+from kubeinfer_tpu.controlplane.httpstore import RemoteStore, StoreServer
+from kubeinfer_tpu.controlplane.store import Store
+from kubeinfer_tpu.resilience import CircuitBreaker
+from kubeinfer_tpu.resilience.faultpoints import REGISTRY, FaultSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every scenario starts disarmed with a known seed and leaves the
+    process-global registry disarmed (other suites share it)."""
+    REGISTRY.disarm()
+    REGISTRY.seed(42)
+    yield
+    REGISTRY.disarm()
+
+
+def _wait_for(cond, timeout: float = 8.0, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# --- scenario A: 503 burst against the store -------------------------------
+
+
+class TestStoreFaults:
+    def test_503_burst_retried_and_observable_on_metrics(self):
+        """Two injected 503s on GETs: the idempotent retry policy rides
+        them out, and the retry/fault counters land on a real /metrics
+        endpoint (the acceptance criterion's exposition check)."""
+        store = Store()
+        server = StoreServer(store, port=0).start()
+        try:
+            remote = RemoteStore(server.address)
+            w = Workload(model_repo="org/m", replicas=[])
+            w.metadata.name = "chaos-a"
+            store.create(Workload.KIND, w.to_dict())
+
+            retries_before = metrics.retry_attempts_total.value("store")
+            faults_before = metrics.fault_injections_total.value(
+                "store.request", "error"
+            )
+            REGISTRY.arm(FaultSpec(
+                "store.request", "error", kind="http_503",
+                match="GET /apis", count=2,
+            ))
+            got = remote.list(Workload.KIND)
+            assert [d["metadata"]["name"] for d in got] == ["chaos-a"]
+            assert metrics.retry_attempts_total.value("store") \
+                - retries_before == 2
+            assert metrics.fault_injections_total.value(
+                "store.request", "error") - faults_before == 2
+
+            # the counters must be scrapeable, not just in-process: serve
+            # the registry exactly like the manager does and fetch it
+            from kubeinfer_tpu.manager import EndpointServer
+
+            ep = EndpointServer(
+                "127.0.0.1", 0,
+                routes={"/metrics": lambda: (
+                    200, "text/plain; version=0.0.4",
+                    metrics.REGISTRY.render(),
+                )},
+            ).start()
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ep.port}/metrics", timeout=5
+                ) as resp:
+                    body = resp.read().decode()
+            finally:
+                ep.shutdown()
+            assert 'kubeinfer_retry_attempts_total{edge="store"}' in body
+            assert ('kubeinfer_fault_injections_total{'
+                    'point="store.request",mode="error"}') in body
+            assert "kubeinfer_breaker_state" in body
+        finally:
+            server.shutdown()
+
+    def test_mutations_do_not_retry_server_errors(self):
+        """A 500 on PUT must pass through: the request may have landed,
+        and only connect-level failures are provably safe to replay."""
+        store = Store()
+        server = StoreServer(store, port=0).start()
+        try:
+            remote = RemoteStore(server.address)
+            w = Workload(model_repo="org/m", replicas=[])
+            w.metadata.name = "chaos-mut"
+            created = remote.create(Workload.KIND, w.to_dict())
+            REGISTRY.arm(FaultSpec(
+                "store.request", "error", kind="http_500",
+                match="PUT ", count=1,
+            ))
+            spec = REGISTRY._specs[-1]
+            with pytest.raises(urllib.error.HTTPError):
+                remote.update(Workload.KIND, created)
+            assert spec.fired == 1  # exactly one attempt, no replay
+        finally:
+            server.shutdown()
+
+
+# --- scenario B: store outage during heartbeats ----------------------------
+
+
+class TestNodeAgentOutage:
+    def test_agent_survives_store_outage_and_reconverges(self, tmp_path):
+        """Connection resets mid-heartbeat, then a REAL outage (server
+        down) lasting well over 2x the heartbeat interval: the agent
+        thread stays alive, serves degraded ticks from last-known
+        bindings, exports staleness, and reconverges when the store
+        returns on the same address."""
+        from kubeinfer_tpu.agent.node_agent import NodeAgent
+
+        node = "chaos-node-b"
+        store = Store()
+        server = StoreServer(store, host="127.0.0.1", port=0).start()
+        port = server.port
+        interval = 0.1
+        remote = RemoteStore(
+            server.address,
+            breaker=CircuitBreaker(
+                edge="store", failure_threshold=2, reset_timeout_s=0.05,
+            ),
+        )
+        agent = NodeAgent(
+            remote, node_name=node, gpu_capacity=4.0,
+            gpu_memory_bytes=1 << 30, model_root=str(tmp_path),
+            heartbeat_interval_s=interval,
+        )
+        degraded_before = metrics.agent_degraded_ticks_total.value(node)
+        opens_before = metrics.breaker_transitions_total.value("store", "open")
+        thread = agent.start()
+        try:
+            assert _wait_for(
+                lambda: store.list(NodeState.KIND)
+                and store.get(NodeState.KIND, node)["ready"]
+            ), "agent never registered its NodeState"
+
+            # phase 1: injected resets on the heartbeat edge — the agent
+            # degrades (counter grows) but keeps ticking
+            REGISTRY.arm(FaultSpec(
+                "agent.heartbeat", "error", kind="reset",
+                match=node, count=2,
+            ))
+            assert _wait_for(
+                lambda: metrics.agent_degraded_ticks_total.value(node)
+                - degraded_before >= 2
+            ), "injected resets never surfaced as degraded ticks"
+            assert thread.is_alive()
+            REGISTRY.disarm()
+
+            # phase 2: real outage, >= 2x heartbeat interval
+            mid_degraded = metrics.agent_degraded_ticks_total.value(node)
+            server.shutdown()
+            time.sleep(6 * interval)
+            assert thread.is_alive(), "agent thread died during the outage"
+            assert metrics.agent_degraded_ticks_total.value(node) \
+                > mid_degraded, "outage ticks were not counted as degraded"
+            assert metrics.agent_store_stale_seconds.value(node) > 0.0
+            # sustained outage trips the shared store breaker
+            assert metrics.breaker_transitions_total.value("store", "open") \
+                > opens_before
+
+            # phase 3: store returns on the SAME address; the agent
+            # reconverges without a restart
+            server2 = StoreServer(store, host="127.0.0.1", port=port).start()
+            try:
+                assert _wait_for(
+                    lambda: metrics.agent_store_stale_seconds.value(node)
+                    == 0.0
+                ), "staleness gauge never recovered after the store returned"
+                hb0 = store.get(NodeState.KIND, node)["heartbeat"]
+                assert _wait_for(
+                    lambda: store.get(
+                        NodeState.KIND, node)["heartbeat"] > hb0
+                ), "heartbeats did not resume after recovery"
+            finally:
+                agent.stop()
+                server2.shutdown()
+        finally:
+            agent.stop()
+
+
+# --- scenario C: coordinator death mid-transfer ----------------------------
+
+
+class TestTransferFaults:
+    def test_sync_model_rides_out_connection_reset(self, tmp_path):
+        from kubeinfer_tpu.agent.model_server import ModelServer
+        from kubeinfer_tpu.agent.transfer import sync_model
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "config.json").write_bytes(b'{"arch": "chaos"}')
+        (src / "weights.bin").write_bytes(b"\x01" * 4096)
+        server = ModelServer(str(src), port=0)
+        server.start()
+        retries_before = metrics.retry_attempts_total.value("transfer.sync")
+        try:
+            # first listing attempt dies like a coordinator mid-failover;
+            # the shared policy re-resolves and completes the sync
+            REGISTRY.arm(FaultSpec(
+                "transfer.fetch", "error", kind="reset", count=1,
+            ))
+            files = sync_model(
+                server.endpoint, str(tmp_path / "dest"),
+                retry_delay_s=0.01,
+            )
+            assert sorted(files) == ["config.json", "weights.bin"]
+            assert (tmp_path / "dest" / "weights.bin").stat().st_size == 4096
+            assert metrics.retry_attempts_total.value("transfer.sync") \
+                - retries_before == 1
+        finally:
+            server.stop()
+
+
+# --- scenario D: long-poll blackhole during standby tailing ----------------
+
+
+class TestReplicaBlackhole:
+    def test_standby_survives_watch_blackhole_and_resumes(self, tmp_path):
+        """A blackholed /watch long-poll trips the standby's failure
+        detector (grace counts RAW poll failures — watch_page is
+        deliberately retry-free); promotion is refused (sibling won the
+        bind), and tailing resumes once the blackhole lifts."""
+        from kubeinfer_tpu.controlplane.replica import StoreReplica
+
+        primary = Store()
+        server = StoreServer(primary, port=0).start()
+        promotion_attempts = []
+        replica = None
+        try:
+            remote = RemoteStore(server.address, request_timeout_s=2.0)
+            replica = StoreReplica(
+                remote, data_dir=str(tmp_path / "replica"),
+                failover_grace_s=0.4, poll_timeout_s=0.2,
+            )
+
+            def on_primary_dead() -> bool:
+                promotion_attempts.append(time.monotonic())
+                return False  # sibling standby won the bind
+
+            replica.start(on_primary_dead)
+            assert replica.wait_synced(5.0)
+            w = Workload(model_repo="org/m", replicas=[])
+            w.metadata.name = "before-blackhole"
+            primary.create(Workload.KIND, w.to_dict())
+            assert _wait_for(
+                lambda: any(
+                    d["metadata"]["name"] == "before-blackhole"
+                    for d in replica.store.list(Workload.KIND)
+                )
+            ), "replica never applied the pre-fault event"
+
+            REGISTRY.arm(FaultSpec(
+                "store.request", "blackhole", match="/watch", delay_s=0.05,
+            ))
+            assert _wait_for(lambda: len(promotion_attempts) >= 1), \
+                "blackholed polls never tripped the failover grace"
+            assert not replica.promoted.is_set()
+            # determinism surface: every injected fault is in the log
+            assert ("store.request", "blackhole") in {
+                (p, m) for p, m, _ in REGISTRY.log
+            }
+
+            REGISTRY.disarm()
+            w2 = Workload(model_repo="org/m", replicas=[])
+            w2.metadata.name = "after-blackhole"
+            primary.create(Workload.KIND, w2.to_dict())
+            assert _wait_for(
+                lambda: any(
+                    d["metadata"]["name"] == "after-blackhole"
+                    for d in replica.store.list(Workload.KIND)
+                )
+            ), "replica did not resume tailing after the blackhole lifted"
+            # the object may have arrived via the post-refusal /dump
+            # resync; `synced` re-asserts only after the first clean
+            # watch page lands, one poll window later
+            assert _wait_for(lambda: replica.synced), \
+                "journal tail never reported live again"
+        finally:
+            if replica is not None:
+                replica.stop()
+            server.shutdown()
+
+
+# --- scenario E: lease-renew partition forces failover ---------------------
+
+
+class TestLeasePartition:
+    def test_partitioned_holder_degrades_and_peer_steals(self):
+        """Transport failures on A's renew edge make A report not-held
+        (stand down BEFORE the TTL — split-brain safety); after expiry B
+        steals the lease. Driven tick-by-tick on a simulated clock."""
+        from kubeinfer_tpu.coordination.lease import LeaseManager
+        from kubeinfer_tpu.utils.clock import SimulatedClock
+
+        clk = SimulatedClock()
+        store = Store()
+        mk = lambda ident: LeaseManager(  # noqa: E731
+            store, "default", "chaos-lease", ident, clock=clk,
+            duration_s=1.0, renew_interval_s=0.6, retry_interval_s=0.2,
+        )
+        a, b = mk("agent-a"), mk("agent-b")
+
+        assert a.try_acquire_or_renew()      # A creates and holds
+        assert not b.try_acquire_or_renew()  # held by live A
+
+        REGISTRY.arm(FaultSpec(
+            "lease.renew", "error", kind="reset", match="agent-a",
+        ))
+        clk.advance(0.2)
+        assert not a.try_acquire_or_renew(), \
+            "a partitioned holder must report not-held"
+        # not yet expired: B cannot steal early
+        clk.advance(0.2)
+        assert not b.try_acquire_or_renew()
+        # past the TTL the peer steals — that IS the failover
+        clk.advance(1.0)
+        assert b.try_acquire_or_renew()
+        assert b.get_holder() == "agent-b"
+        # A stays partitioned and never reclaims
+        assert not a.try_acquire_or_renew()
+
+        # partition heals: A observes B's live lease and stays follower
+        REGISTRY.disarm()
+        clk.advance(0.2)
+        assert not a.try_acquire_or_renew()
+        assert a.get_holder() == "agent-b"
+
+
+# --- the harness itself ----------------------------------------------------
+
+
+class TestHarnessDeterminism:
+    def test_seeded_fault_sequence_replays_identically(self):
+        """Same seed + same call sequence => identical firing log, even
+        for probabilistic (rate < 1) specs — the property every scenario
+        above leans on."""
+        def run_once() -> list[tuple[str, str, str]]:
+            REGISTRY.disarm()
+            REGISTRY.arm(
+                FaultSpec("store.request", "error", kind="reset",
+                          rate=0.5),
+                FaultSpec("agent.heartbeat", "error", kind="timeout",
+                          rate=0.3, after=2),
+            )
+            REGISTRY.seed(1234)
+            for i in range(40):
+                for point in ("store.request", "agent.heartbeat"):
+                    try:
+                        REGISTRY.fire(point, key=f"k{i}")
+                    except OSError:
+                        pass
+            return list(REGISTRY.log)
+
+        first, second = run_once(), run_once()
+        assert first == second
+        assert first, "rate=0.5 over 40 passes must fire at least once"
+
+    def test_disarmed_points_are_free_of_side_effects(self):
+        before = metrics.fault_injections_total.value("store.request", "error")
+        for _ in range(100):
+            REGISTRY.fire("store.request", key="GET /apis/x")
+        assert metrics.fault_injections_total.value(
+            "store.request", "error") == before
+        assert REGISTRY.log == []

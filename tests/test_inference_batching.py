@@ -216,12 +216,15 @@ class TestSpeculativeRouting:
         per-row, so heterogeneous sampled arrivals no longer forfeit
         speculation to each other. Distribution exactness of the
         per-row correction is pinned in test_speculative; here the
-        GROUPING is the contract."""
+        GROUPING is the contract. Seeds must MATCH: the group's key
+        stream is seeded by the head request, so a join with a
+        different seed would silently drop the joiner's seed (PR 1
+        reproducibility guard)."""
         batches: list[int] = []
         eng, _, _ = self._engines(n_slots=4, count_batches=batches)
         reqs = [
             eng.submit([2, 3], max_new_tokens=4,
-                       temperature=0.6 + 0.2 * i, seed=i)
+                       temperature=0.6 + 0.2 * i, seed=7)
             for i in range(3)
         ]
         eng.start()
@@ -232,6 +235,28 @@ class TestSpeculativeRouting:
                 assert len(r.out_tokens) == 4
             assert eng.spec_served == 3
             assert batches == [3], batches
+        finally:
+            eng.stop()
+
+    def test_sampled_mismatched_seeds_do_not_join(self):
+        """The other half of the reproducibility guard: a sampled
+        request whose seed differs from the group head is NOT joinable
+        (it would sample from the head's key stream, making its output
+        depend on concurrent traffic). The drain stops at it, the head
+        rides the draft alone, and the holdover lands on a slot — same
+        mechanics as the repetition-penalty holdover above."""
+        batches: list[int] = []
+        eng, _, _ = self._engines(n_slots=4, count_batches=batches)
+        head = eng.submit([2, 3], max_new_tokens=4, temperature=0.7, seed=1)
+        other = eng.submit([2, 3], max_new_tokens=4, temperature=0.7, seed=2)
+        eng.start()
+        try:
+            for r in (head, other):
+                assert r.done.wait(120)
+                assert not r.failed
+                assert len(r.out_tokens) == 4
+            assert eng.spec_served == 1
+            assert batches == [1], batches
         finally:
             eng.stop()
 

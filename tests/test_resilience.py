@@ -1,0 +1,283 @@
+"""Unit tests for the resilience core: RetryPolicy backoff/deadline/
+classification and CircuitBreaker transitions.
+
+Scheduling is exercised through injected rng/clock/sleep so every
+assertion is deterministic — no wall-clock sleeps, no flaky timing.
+Metric assertions measure DELTAS (the process-global registry is shared
+with other tests in the session).
+"""
+
+from __future__ import annotations
+
+import email.message
+import io
+import json
+import random
+import urllib.error
+
+import pytest
+
+from kubeinfer_tpu import metrics
+from kubeinfer_tpu.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    RetryPolicy,
+    connect_failure,
+    is_transport_error,
+    transient_http,
+)
+
+
+def _http_error(code: int) -> urllib.error.HTTPError:
+    return urllib.error.HTTPError(
+        "http://test.invalid/", code, "injected", email.message.Message(),
+        io.BytesIO(b"{}"),
+    )
+
+
+class FakeClock:
+    """Monotonic clock whose sleep() advances it — retry schedules run
+    in zero wall time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, d: float) -> None:
+        assert d >= 0
+        self.t += d
+
+
+# --- classifiers -----------------------------------------------------------
+
+
+class TestClassifiers:
+    def test_transient_http_status_codes(self):
+        for code in (429, 500, 502, 503, 504):
+            assert transient_http(_http_error(code))
+        for code in (400, 401, 404, 409, 501):
+            assert not transient_http(_http_error(code))
+
+    def test_transient_http_connection_errors(self):
+        assert transient_http(ConnectionResetError())
+        assert transient_http(TimeoutError())
+        assert transient_http(urllib.error.URLError(ConnectionRefusedError()))
+        # a torn JSON body is a transport failure even though json
+        # surfaces it as a ValueError subclass...
+        assert transient_http(json.JSONDecodeError("x", "{", 1))
+        # ...but plain ValueErrors (domain errors subclass it) are NOT
+        assert not transient_http(ValueError("already exists"))
+        assert not transient_http(KeyError("k"))
+
+    def test_connect_failure_is_narrower(self):
+        assert connect_failure(ConnectionRefusedError())
+        assert connect_failure(urllib.error.URLError(ConnectionRefusedError()))
+        # these may have reached the server — a mutation must not replay
+        assert not connect_failure(ConnectionResetError())
+        assert not connect_failure(TimeoutError())
+        assert not connect_failure(_http_error(503))
+
+    def test_breaker_open_error_is_connectionerror(self):
+        # existing `except OSError` handlers must absorb fast-fails
+        assert issubclass(BreakerOpenError, ConnectionError)
+        assert is_transport_error(BreakerOpenError("open"))
+
+
+# --- RetryPolicy -----------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_jitter_bounds_and_determinism(self):
+        p = RetryPolicy(base_delay_s=0.1, max_delay_s=2.0)
+        rng = random.Random(7)
+        delays = [p.backoff(a, rng) for a in range(8) for _ in range(50)]
+        for a in range(8):
+            cap = min(2.0, 0.1 * 2**a)
+            for d in delays[a * 50:(a + 1) * 50]:
+                assert 0.0 <= d <= cap
+        # full jitter actually spreads (not constant/equal-delay backoff)
+        assert len({round(d, 9) for d in delays[:50]}) > 10
+        # same seed → identical schedule
+        rng2 = random.Random(7)
+        assert delays == [p.backoff(a, rng2) for a in range(8) for _ in range(50)]
+
+    def test_success_after_failures_counts_retries(self):
+        clk = FakeClock()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionResetError("blip")
+            return 42
+
+        before = metrics.retry_attempts_total.value("unit.t1")
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.01, deadline_s=0)
+        out = p.call(fn, edge="unit.t1", rng=random.Random(1),
+                     sleep=clk.sleep, clock=clk)
+        assert out == 42
+        assert len(calls) == 3
+        assert metrics.retry_attempts_total.value("unit.t1") - before == 2
+
+    def test_non_retryable_passes_through_first_attempt(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("domain error")
+
+        p = RetryPolicy(max_attempts=5, deadline_s=0)
+        with pytest.raises(ValueError):
+            p.call(fn, rng=random.Random(0), sleep=lambda d: None)
+        assert len(calls) == 1
+
+        # classify narrows further: a reset is transient for GETs but
+        # not under connect_failure (the mutation classifier)
+        calls.clear()
+
+        def reset():
+            calls.append(1)
+            raise ConnectionResetError("maybe landed")
+
+        pm = RetryPolicy(max_attempts=5, deadline_s=0, classify=connect_failure)
+        with pytest.raises(ConnectionResetError):
+            pm.call(reset, rng=random.Random(0), sleep=lambda d: None)
+        assert len(calls) == 1
+
+    def test_attempt_budget_exhaustion_raises_original(self):
+        clk = FakeClock()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise _http_error(503)
+
+        before = metrics.retries_exhausted_total.value("unit.t2")
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.01, deadline_s=0)
+        with pytest.raises(urllib.error.HTTPError):
+            p.call(fn, edge="unit.t2", rng=random.Random(2),
+                   sleep=clk.sleep, clock=clk)
+        assert len(calls) == 3
+        assert metrics.retries_exhausted_total.value("unit.t2") - before == 1
+
+    def test_deadline_caps_schedule(self):
+        clk = FakeClock()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            clk.t += 0.4  # each attempt costs 0.4s of budget
+            raise TimeoutError("slow edge")
+
+        p = RetryPolicy(max_attempts=100, base_delay_s=0.5, max_delay_s=0.5,
+                        deadline_s=1.0)
+        with pytest.raises(TimeoutError):
+            p.call(fn, rng=random.Random(3), sleep=clk.sleep, clock=clk)
+        # far fewer than max_attempts: the deadline stopped the schedule,
+        # and never by sleeping past it (give-up happens pre-sleep)
+        assert len(calls) < 6
+        assert clk.t <= 1.0 + 0.4  # last attempt's own cost may overshoot
+
+    def test_zero_deadline_disables_cap(self):
+        clk = FakeClock()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            clk.t += 100.0
+            raise ConnectionResetError()
+
+        p = RetryPolicy(max_attempts=4, base_delay_s=0.01, deadline_s=0)
+        with pytest.raises(ConnectionResetError):
+            p.call(fn, rng=random.Random(4), sleep=clk.sleep, clock=clk)
+        assert len(calls) == 4  # attempts, not elapsed time, bounded it
+
+
+# --- CircuitBreaker --------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_full_transition_cycle(self):
+        clk = FakeClock()
+        edge = "unit.brk1"
+        t_before = {
+            to: metrics.breaker_transitions_total.value(edge, to)
+            for to in ("open", "half-open", "closed")
+        }
+        b = CircuitBreaker(edge=edge, failure_threshold=2,
+                           reset_timeout_s=5.0, clock=clk)
+        assert b.state == "closed"
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "closed"  # below threshold
+        b.record_failure()
+        assert b.state == "open"
+        assert metrics.breaker_state.value(edge) == 1
+        assert not b.allow()  # cooldown not elapsed
+        clk.t += 5.0
+        assert b.allow()  # admitted as the half-open probe
+        assert b.state == "half-open"
+        assert metrics.breaker_state.value(edge) == 2
+        b.record_success()
+        assert b.state == "closed"
+        assert metrics.breaker_state.value(edge) == 0
+        for to, n in (("open", 1), ("half-open", 1), ("closed", 1)):
+            assert (
+                metrics.breaker_transitions_total.value(edge, to)
+                - t_before[to] == n
+            ), to
+
+    def test_half_open_admits_single_probe(self):
+        clk = FakeClock()
+        b = CircuitBreaker(edge="unit.brk2", failure_threshold=1,
+                           reset_timeout_s=1.0, clock=clk)
+        b.record_failure()
+        assert b.state == "open"
+        clk.t += 1.0
+        assert b.allow()       # the probe
+        assert not b.allow()   # concurrent callers keep failing fast
+        b.record_failure()     # probe failed → re-open, cooldown restarts
+        assert b.state == "open"
+        assert not b.allow()
+        clk.t += 1.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_policy_fails_fast_when_open(self):
+        clk = FakeClock()
+        b = CircuitBreaker(edge="unit.brk3", failure_threshold=1,
+                           reset_timeout_s=10.0, clock=clk)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionRefusedError()
+
+        p = RetryPolicy(max_attempts=1, deadline_s=0)
+        with pytest.raises(ConnectionRefusedError):
+            p.call(fn, edge="unit.brk3", breaker=b, sleep=clk.sleep, clock=clk)
+        assert b.state == "open"
+        # second call never reaches fn: microsecond fail-fast
+        with pytest.raises(BreakerOpenError):
+            p.call(fn, edge="unit.brk3", breaker=b, sleep=clk.sleep, clock=clk)
+        assert len(calls) == 1
+
+    def test_domain_errors_count_as_edge_success(self):
+        # a 404 means the server ANSWERED: the edge is healthy and must
+        # not trip, no matter how many domain errors a caller collects
+        clk = FakeClock()
+        b = CircuitBreaker(edge="unit.brk4", failure_threshold=1,
+                           reset_timeout_s=1.0, clock=clk)
+        p = RetryPolicy(max_attempts=1, deadline_s=0)
+
+        def fn():
+            raise ValueError("not found")
+
+        for _ in range(5):
+            with pytest.raises(ValueError):
+                p.call(fn, edge="unit.brk4", breaker=b,
+                       sleep=clk.sleep, clock=clk)
+        assert b.state == "closed"
