@@ -8,7 +8,7 @@ PY ?= python
 .PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
         bench-full lint run-manager run-agent docker-build clean
 
-all: native test-fast
+all: native lint test-fast
 
 native:
 	$(MAKE) -C native
@@ -40,8 +40,13 @@ bench-quick: native
 bench-full: native
 	$(PY) bench.py --full
 
+# Syntax (compileall) + invariant analyzer (kubeinfer_tpu/analysis/):
+# jit purity, static shapes under jit, lock discipline. Exits non-zero
+# on any unsuppressed `file:line rule message` finding; the same scan
+# is a tier-1 gate via tests/test_static_analysis.py.
 lint:
-	$(PY) -m compileall -q kubeinfer_tpu tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q kubeinfer_tpu tests scripts bench.py __graft_entry__.py
+	$(PY) -m kubeinfer_tpu.analysis kubeinfer_tpu tests scripts bench.py __graft_entry__.py
 
 # local quickstart helpers (see README)
 run-manager:

@@ -1,0 +1,46 @@
+"""Static analysis + runtime race sentinel for kubeinfer_tpu.
+
+Two pillars (ISSUE 2):
+
+- AST lint passes (``core``/``jitlint``/``lockcheck``): jit purity
+  (host syncs, traced branches), static shapes under jit, and lock
+  discipline. Run via ``python -m kubeinfer_tpu.analysis`` or
+  ``make lint``; enforced in tier-1 by tests/test_static_analysis.py.
+- Runtime lock-order sentinel (``racecheck``): instrumented locks that
+  build an acquisition-order graph and report cycles + hold times,
+  armed by ``KUBEINFER_RACECHECK=1`` (the chaos tier arms it).
+
+Import cost note: this ``__init__`` re-exports only the runtime pieces
+(every locked component imports ``make_lock`` at startup); the AST
+machinery loads lazily when analysis actually runs.
+"""
+
+from kubeinfer_tpu.analysis.racecheck import (  # noqa: F401
+    REGISTRY,
+    armed,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
+
+__all__ = [
+    "REGISTRY",
+    "armed",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+    "analyze_paths",
+    "analyze_source",
+]
+
+
+def analyze_paths(paths):  # lazy: see module docstring
+    from kubeinfer_tpu.analysis.core import analyze_paths as _ap
+
+    return _ap(paths)
+
+
+def analyze_source(source, path="<string>", **kw):
+    from kubeinfer_tpu.analysis.core import analyze_source as _as
+
+    return _as(source, path, **kw)

@@ -1,0 +1,51 @@
+"""CLI: ``python -m kubeinfer_tpu.analysis [paths...]``.
+
+Prints one ``file:line rule message`` line per unsuppressed finding
+(grep/editor-clickable) and exits 1 if there are any — so ``make lint``
+and CI gate on it with no extra plumbing. With no paths, scans the
+default surface: the package, tests, bench.py, __graft_entry__.py, and
+scripts/ (ISSUE 2: bench code is where host-sync regressions hurt
+``device_solve_ms`` most).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from kubeinfer_tpu.analysis.core import RULES, analyze_paths
+
+_DEFAULT_PATHS = [
+    "kubeinfer_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py",
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubeinfer_tpu.analysis",
+        description="kubeinfer_tpu invariant linter "
+                    "(jit purity, static shapes, lock discipline)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: whole repo surface)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids + descriptions and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}: {desc}")
+        return 0
+    paths = args.paths or [p for p in _DEFAULT_PATHS if Path(p).exists()]
+    findings, nfiles = analyze_paths(paths)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"\n{len(findings)} finding(s) in {nfiles} file(s)",
+              file=sys.stderr)
+        return 1
+    print(f"analysis clean: {nfiles} file(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""Analyzer framework: findings, suppressions, file walking, orchestration.
+
+Design: two-phase whole-tree scan. Phase 1 collects the names of every
+jit-compiled function across ALL scanned files (decorator forms plus
+``jax.jit(fn)`` call forms), because callers in other files — bench.py
+calling ``solve_greedy`` — must treat those results as device values.
+Phase 2 runs the per-file passes (jitlint, lockcheck) with that global
+registry in hand. Single-file entry points (``analyze_source``) exist
+for the analyzer's own fixture tests.
+
+Suppression contract (ISSUE 2): ``# lint: allow[rule] reason`` on the
+finding's line or on a comment-only line directly above it. The reason
+is mandatory — a bare allow is itself a finding (``lint-bare-allow``)
+that cannot be suppressed, so suppressions stay documented. There is
+deliberately no file-level or block-level suppression syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_source",
+    "analyze_paths",
+    "iter_py_files",
+]
+
+# rule id -> one-line description (CLI --list-rules; allow[] validation)
+RULES = {
+    "jit-host-sync": (
+        "host sync inside a jit-compiled function (.item()/.tolist()/"
+        "int()/float()/bool()/np.asarray/jax.device_get on traced values)"
+    ),
+    "jit-traced-branch": (
+        "Python if/while/assert on a traced value inside a jit-compiled "
+        "function (use lax.cond/lax.while_loop/jnp.where)"
+    ),
+    "jit-dynamic-shape": (
+        "dynamic-shape op under jit (jnp.nonzero/argwhere without size=, "
+        "jnp.unique, single-arg jnp.where, boolean-mask indexing)"
+    ),
+    "host-sync": (
+        "device->host readback outside jit (np.asarray/.item()/.tolist()/"
+        "int()/bool()/jax.device_get of a jit result) — intended serving "
+        "boundaries must carry a reasoned allow"
+    ),
+    "lock-discipline": (
+        "attribute written both under its class lock and outside any lock"
+    ),
+    "lint-bare-allow": (
+        "a `# lint: allow[rule]` without a reason string (reasons are "
+        "mandatory; this finding is itself unsuppressable)"
+    ),
+    "lint-unknown-rule": "allow[] names a rule the analyzer does not define",
+    "parse-error": "file failed to parse as Python",
+}
+
+# Matched against the raw line text, so it finds trailing comments too.
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\[([A-Za-z0-9_,\s-]+)\]\s*(.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        # file:line rule message — grep/editor-clickable (ISSUE 2 CI task)
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+@dataclass
+class _Suppressions:
+    # line number (1-based) -> set of rule ids allowed on that line
+    by_line: dict = field(default_factory=dict)
+    meta_findings: list = field(default_factory=list)
+
+    def allows(self, finding: Finding) -> bool:
+        if finding.rule in ("lint-bare-allow", "lint-unknown-rule"):
+            return False
+        return finding.rule in self.by_line.get(finding.line, ())
+
+
+def _iter_comments(source: str):
+    """(line, column, text) for every real COMMENT token — a tokenizer
+    pass, not a text scan, so docstrings that *mention* the allow syntax
+    (like this package's own) are not treated as suppressions."""
+    import io
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # parse errors surface via ast.parse as parse-error
+
+
+def _collect_suppressions(source: str, path: str) -> _Suppressions:
+    sup = _Suppressions()
+    lines = source.splitlines()
+    for i, col, text in _iter_comments(source):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        if not reason:
+            sup.meta_findings.append(
+                Finding(path, i, "lint-bare-allow",
+                        f"allow[{m.group(1)}] has no reason")
+            )
+        for r in rules:
+            if r not in RULES:
+                sup.meta_findings.append(
+                    Finding(path, i, "lint-unknown-rule",
+                            f"unknown rule {r!r} in allow[]")
+                )
+        # an allow on a comment-only line (column 0 after indent — no
+        # code before it) also covers the next line of code, so long
+        # suppression reasons don't force long source lines
+        line_text = lines[i - 1] if i <= len(lines) else ""
+        targets = [i]
+        if line_text[:col].strip() == "":
+            targets.append(i + 1)
+        for t in targets:
+            sup.by_line.setdefault(t, set()).update(rules)
+    return sup
+
+
+def iter_py_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    # dedupe while keeping order (overlapping path args)
+    seen: set[Path] = set()
+    uniq = []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def _is_test_file(path: str) -> bool:
+    parts = Path(path).parts
+    name = Path(path).name
+    return "tests" in parts or name.startswith("test_") or name == "conftest.py"
+
+
+def _read(path: Path) -> str:
+    # tokenize.open honours PEP 263 coding cookies, same as CPython
+    with tokenize.open(path) as fh:
+        return fh.read()
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    jit_registry: dict | None = None,
+    boundary: bool | None = None,
+) -> list[Finding]:
+    """Analyze one file's source; returns UNSUPPRESSED findings only.
+
+    ``boundary`` controls the outside-jit host-sync rule; default: on
+    except for test files (tests legitimately read results back en
+    masse — flagging hundreds of asserts would bury the signal).
+    """
+    # local imports: core is imported by racecheck users at runtime and
+    # must not pay for the AST passes unless analysis actually runs
+    from kubeinfer_tpu.analysis import jitlint, lockcheck
+
+    if boundary is None:
+        boundary = not _is_test_file(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "parse-error", str(e.msg))]
+    # Cross-file registry only informs CALL-site taint (bench.py calling
+    # solve_greedy). Marking function DEFINITIONS as jit is per-file —
+    # an unrelated function sharing a jit entry's bare name elsewhere in
+    # the tree must not be analyzed as traced.
+    local = jitlint.collect_jit_names(tree)
+    call_registry = dict(jit_registry or {})
+    call_registry.update(local)
+    findings: list[Finding] = []
+    findings.extend(jitlint.run(tree, path, call_registry,
+                                def_registry=local, boundary=boundary))
+    findings.extend(lockcheck.run(tree, path))
+    sup = _collect_suppressions(source, path)
+    findings = [f for f in findings if not sup.allows(f)]
+    findings.extend(sup.meta_findings)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_paths(paths) -> tuple[list[Finding], int]:
+    """Two-phase scan over files/dirs; returns (findings, files_scanned)."""
+    from kubeinfer_tpu.analysis import jitlint
+
+    files = iter_py_files(paths)
+    sources: dict[Path, str] = {}
+    trees: dict[Path, ast.AST] = {}
+    findings: list[Finding] = []
+    registry: dict[str, frozenset] = {}
+    for f in files:
+        try:
+            src = _read(f)
+            tree = ast.parse(src, filename=str(f))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            findings.append(Finding(str(f), line, "parse-error", str(e)))
+            continue
+        sources[f] = src
+        trees[f] = tree
+        registry.update(jitlint.collect_jit_names(tree))
+    for f, tree in trees.items():
+        findings.extend(
+            analyze_source(sources[f], str(f), jit_registry=registry)
+        )
+    return findings, len(files)

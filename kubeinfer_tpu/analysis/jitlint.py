@@ -1,0 +1,608 @@
+"""Jit-purity and static-shape AST passes.
+
+Three in-jit rules plus one boundary rule, all driven by one
+flow-sensitive taint walk:
+
+- ``jit-host-sync``: ``.item()``/``.tolist()``/``int()``/``float()``/
+  ``bool()``/``np.asarray(...)`` on traced values, or ``jax.device_get``
+  anywhere, inside a jit-compiled function. These either crash under
+  trace or silently force a device round-trip per call.
+- ``jit-traced-branch``: Python ``if``/``while``/``assert``/ternary on
+  a traced value (CLAUDE.md: no data-dependent Python control flow
+  under jit — use ``lax.cond``/``lax.while_loop``/``jnp.where``).
+  ``x is None`` tests are exempt: identity against a sentinel is
+  resolved at trace time, never on data.
+- ``jit-dynamic-shape``: ``jnp.nonzero``/``argwhere``/``flatnonzero``
+  without ``size=``, any ``jnp.unique*``, single-argument ``jnp.where``,
+  boolean-mask indexing. Output shape depends on data → retrace bomb
+  (CLAUDE.md: static shapes only, bucketed padding).
+- ``host-sync`` (outside jit): the same sink set applied to values that
+  flow from jit-compiled calls — every device→host readback on a
+  serving path must be an *intended* boundary, documented with
+  ``# lint: allow[host-sync] reason``. Off for test files.
+
+Taint model: parameters of jit functions (minus static_argnames/nums)
+and results of ``jnp.*``/``jax.*``/known-jit calls are traced. Taint
+propagates through arithmetic, tuples, attribute chains (``g.state``),
+and unknown calls; ``.shape``/``.dtype``/``.ndim`` reads and the sink
+casts themselves yield host values (so ``int(np.asarray(x)[0])``
+reports once, at the asarray). Flow is a single forward pass per
+function — no fixpoint over loops; a value tainted anywhere in a loop
+body stays tainted for the rest of the walk, which is the conservative
+direction.
+
+Known-jit names are collected across the WHOLE scan first
+(``collect_jit_names``), so ``bench.py`` calling ``solve_greedy`` sees
+a device value even though the decorator lives in solver/core.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeinfer_tpu.analysis.core import Finding
+
+__all__ = ["collect_jit_names", "run"]
+
+_NUMPY_MODS = ("np", "numpy", "onp")
+_NP_SINK_FNS = ("asarray", "array", "ascontiguousarray", "asfortranarray", "copy")
+_NP_SINKS = {f"{m}.{fn}" for m in _NUMPY_MODS for fn in _NP_SINK_FNS}
+_CAST_SINKS = {"int", "float", "bool", "complex"}
+_SINK_METHODS = {"item", "tolist"}
+# attribute reads that yield static/host metadata, not traced data
+_UNTAINT_ATTRS = {
+    "shape", "dtype", "ndim", "size", "weak_type", "sharding", "aval",
+    "itemsize", "nbytes",
+}
+_UNTAINT_CALLS = {
+    "len", "range", "enumerate", "isinstance", "issubclass", "hasattr",
+    "callable", "type", "id", "repr", "str", "format", "print", "sorted",
+}
+# jax API calls that return HOST data (device handles, ints, strings),
+# not arrays — results are not traced values
+_HOST_JAX_CALLS = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_index", "jax.process_count",
+    "jax.default_backend", "jax.tree_util.tree_structure",
+    "jax.eval_shape", "jax.make_mesh",
+}
+_DYN_NEED_SIZE = {"nonzero", "argwhere", "flatnonzero"}
+_DYN_ALWAYS = {"unique", "unique_values", "unique_counts", "unique_inverse",
+               "unique_all"}
+# boolean-producing calls that make a mask when used as a subscript index
+_MASK_CALLS = {"isnan", "isinf", "isfinite", "logical_and", "logical_or",
+               "logical_not", "logical_xor", "isclose", "equal", "not_equal",
+               "greater", "less", "greater_equal", "less_equal"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_strs(node: ast.AST) -> frozenset:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return frozenset(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return frozenset()
+
+
+def _const_ints(node: ast.AST) -> frozenset:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return frozenset(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return frozenset()
+
+
+def _jit_call_statics(call: ast.Call) -> tuple:
+    names: frozenset = frozenset()
+    nums: frozenset = frozenset()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            nums = _const_ints(kw.value)
+    return (names, nums)
+
+
+def _jit_decorator_statics(dec: ast.AST):
+    """(static_argnames, static_argnums) if ``dec`` jit-compiles, else None.
+
+    Recognized forms: ``@jax.jit``, ``@jax.jit(...)``,
+    ``@functools.partial(jax.jit, ...)``, ``@partial(jax.jit, ...)``.
+    """
+    if _dotted(dec) == "jax.jit":
+        return (frozenset(), frozenset())
+    if isinstance(dec, ast.Call):
+        fn = _dotted(dec.func)
+        if fn == "jax.jit":
+            return _jit_call_statics(dec)
+        if fn in ("functools.partial", "partial") and dec.args:
+            if _dotted(dec.args[0]) == "jax.jit":
+                return _jit_call_statics(dec)
+    return None
+
+
+def collect_jit_names(tree: ast.AST) -> dict:
+    """Map of function NAME -> (static_argnames, static_argnums) for every
+    jit-compiled function in the tree (decorator and call forms)."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                statics = _jit_decorator_statics(dec)
+                if statics is not None:
+                    out[node.name] = statics
+        elif isinstance(node, ast.Call) and _dotted(node.func) == "jax.jit":
+            statics = _jit_call_statics(node)
+            target = node.args[0] if node.args else None
+            # jax.jit(jax.shard_map(fn, ...)) — the inner fn is the body
+            if (isinstance(target, ast.Call)
+                    and (_dotted(target.func) or "").endswith("shard_map")
+                    and target.args):
+                target = target.args[0]
+            if isinstance(target, ast.Name):
+                out.setdefault(target.id, statics)
+        elif isinstance(node, ast.Assign):
+            # forward_jit = jax.jit(forward, ...): results of calling the
+            # ASSIGNED name are device values too
+            v = node.value
+            if isinstance(v, ast.Call) and _dotted(v.func) == "jax.jit":
+                statics = _jit_call_statics(v)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, statics)
+    return out
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """True for tests resolved at trace time: pure identity comparisons
+    (``x is None``) and boolean combinations thereof."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_static_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_test(test.operand)
+    return False
+
+
+class _Scope:
+    """One function (or module) body: forward taint walk + sink reporting."""
+
+    def __init__(self, path, findings, registry, *, in_jit, boundary, env,
+                 def_registry=None):
+        self.path = path
+        self.findings = findings
+        self.registry = registry  # call-site taint (cross-file)
+        # which local defs are jit entries (THIS file only — a bare-name
+        # match against another file's jit fn must not trace this one)
+        self.def_registry = def_registry if def_registry is not None \
+            else registry
+        self.in_jit = in_jit
+        self.boundary = boundary
+        self.env = env  # set of tainted name / dotted-attr keys
+        self._seen: set[tuple] = set()
+
+    # -- reporting --------------------------------------------------------
+
+    def _emit(self, node, rule, message):
+        key = (node.lineno, getattr(node, "col_offset", 0), rule)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(self.path, node.lineno, rule, message))
+
+    def sync(self, node, what):
+        if self.in_jit:
+            self._emit(node, "jit-host-sync", f"{what} inside jit")
+        elif self.boundary:
+            self._emit(node, "host-sync", f"{what} on a jit result")
+
+    def dyn(self, node, what):
+        if self.in_jit:
+            self._emit(node, "jit-dynamic-shape", what)
+
+    # -- expression taint (side effect: reports sinks) --------------------
+
+    def taint(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.env
+        if isinstance(node, ast.Attribute):
+            key = _dotted(node)
+            if key and key in self.env:
+                return True
+            base = self.taint(node.value)
+            if node.attr in _UNTAINT_ATTRS:
+                return False
+            return base
+        if isinstance(node, ast.Subscript):
+            idx = node.slice
+            self.taint(idx)
+            if self.in_jit and self._is_mask(idx):
+                self.dyn(node, "boolean-mask indexing (data-dependent shape)")
+            return self.taint(node.value)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            lt = self.taint(node.left)
+            rt = self.taint(node.right)
+            return lt or rt
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any([self.taint(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            ts = [self.taint(node.left)]
+            ts += [self.taint(c) for c in node.comparators]
+            # identity comparison yields a Python bool even on arrays
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return any(ts)
+        if isinstance(node, ast.IfExp):
+            tt = self.taint(node.test)
+            if self.in_jit and tt and not _is_static_test(node.test):
+                self._emit(node, "jit-traced-branch",
+                           "ternary on a traced value inside jit")
+            bt = self.taint(node.body)
+            ot = self.taint(node.orelse)
+            return bt or ot
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.taint(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            ks = [self.taint(k) for k in node.keys if k is not None]
+            vs = [self.taint(v) for v in node.values]
+            return any(ks) or any(vs)
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for ch in ast.iter_child_nodes(node):
+                self.taint(ch)
+            return False
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._comprehension(node)
+        if isinstance(node, ast.NamedExpr):
+            t = self.taint(node.value)
+            self._bind_target(node.target, t)
+            return t
+        if isinstance(node, ast.Slice):
+            return any([self.taint(x) for x in
+                        (node.lower, node.upper, node.step) if x is not None])
+        if isinstance(node, ast.Lambda):
+            # body is analyzed only when jit-wrapped (see _call); a bare
+            # lambda's params are unbound here so taint would be noise
+            return False
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.taint(node.value)
+        if isinstance(node, ast.Yield):
+            return self.taint(node.value) if node.value else False
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, ast.expr):
+                self.taint(ch)
+        return False
+
+    def _is_mask(self, idx) -> bool:
+        if isinstance(idx, ast.Compare):
+            return not all(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in idx.ops)
+        if isinstance(idx, ast.Call):
+            chain = _dotted(idx.func) or ""
+            return chain.split(".")[-1] in _MASK_CALLS
+        if isinstance(idx, ast.UnaryOp) and isinstance(idx.op, ast.Invert):
+            return self._is_mask(idx.operand)
+        return False
+
+    def _comprehension(self, node) -> bool:
+        bound: list[str] = []
+        for gen in node.generators:
+            it = self.taint(gen.iter)
+            names = [n.id for n in ast.walk(gen.target)
+                     if isinstance(n, ast.Name)]
+            for name in names:
+                if it:
+                    if name not in self.env:
+                        self.env.add(name)
+                        bound.append(name)
+                else:
+                    self.env.discard(name)
+            for cond in gen.ifs:
+                self.taint(cond)
+        if isinstance(node, ast.DictComp):
+            t = self.taint(node.key) or self.taint(node.value)
+        else:
+            t = self.taint(node.elt)
+        for name in bound:
+            self.env.discard(name)
+        return t
+
+    def _call(self, node: ast.Call) -> bool:
+        chain = _dotted(node.func)
+        arg_taints = [self.taint(a) for a in node.args]
+        kw_taints = [self.taint(k.value) for k in node.keywords]
+        any_arg = any(arg_taints) or any(kw_taints)
+        kwnames = {k.arg for k in node.keywords}
+
+        if chain == "jax.jit":
+            self._jit_wrapped_lambda(node)
+            return True  # the wrapper itself produces device results
+
+        if chain == "jax.device_get":
+            # definitionally a device->host readback, tainted or not: the
+            # jit-result heuristic can't see through helper returns, and
+            # there is no other reason to call device_get
+            self.sync(node, "jax.device_get")
+            return False
+
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            base_t = self.taint(node.func.value)
+            if meth in _SINK_METHODS and base_t:
+                self.sync(node, f".{meth}()")
+                return False
+            if meth == "block_until_ready" and (base_t or self.in_jit):
+                self.sync(node, ".block_until_ready()")
+                return False
+            if meth == "compress" and base_t:
+                self.dyn(node, ".compress() (data-dependent shape)")
+                return True
+        else:
+            base_t = False
+
+        if chain in _CAST_SINKS and any_arg:
+            self.sync(node, f"{chain}() on a traced value")
+            return False
+        if chain in _NP_SINKS and any_arg:
+            self.sync(node, f"{chain}() of a traced value")
+            return False
+
+        parts = chain.split(".") if chain else []
+        if self.in_jit and parts and parts[0] in ("jnp", "np", "numpy",
+                                                  "jax", "lax"):
+            last = parts[-1]
+            if last in _DYN_NEED_SIZE and "size" not in kwnames:
+                self.dyn(node, f"{chain}() without size= under jit")
+            elif last in _DYN_ALWAYS:
+                self.dyn(node, f"{chain}() under jit (data-dependent shape)")
+            elif last == "where" and len(node.args) == 1 \
+                    and "size" not in kwnames:
+                self.dyn(node, "single-argument jnp.where under jit "
+                               "(data-dependent shape)")
+
+        if chain in _UNTAINT_CALLS or chain in _HOST_JAX_CALLS:
+            return False
+        if parts and parts[0] in ("jnp", "lax"):
+            return True
+        if chain and chain.startswith("jax."):
+            return True
+        if chain and chain in self.registry:
+            return True
+        if isinstance(node.func, ast.Attribute) and base_t:
+            return True  # x.sum(), x.astype(), x.reshape() stay on device
+        return any_arg  # unknown callables pass taint through
+
+    def _jit_wrapped_lambda(self, node: ast.Call) -> None:
+        target = node.args[0] if node.args else None
+        if (isinstance(target, ast.Call)
+                and (_dotted(target.func) or "").endswith("shard_map")
+                and target.args):
+            target = target.args[0]
+        if isinstance(target, ast.Lambda):
+            # same free-variable rule as _handle_def: a jit entry's
+            # closure is concrete unless we are already tracing
+            lam_env = set(self.env) if self.in_jit else set()
+            child = _Scope(self.path, self.findings, self.registry,
+                           in_jit=True, boundary=False, env=lam_env,
+                           def_registry=self.def_registry)
+            a = target.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                child.env.add(p.arg)
+            for v in (a.vararg, a.kwarg):
+                if v is not None:
+                    child.env.add(v.arg)
+            child.taint(target.body)
+            self._seen.update(child._seen)
+
+    # -- binding ----------------------------------------------------------
+
+    def _bind_target(self, tgt, tainted: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            if tainted:
+                self.env.add(tgt.id)
+            else:
+                self.env.discard(tgt.id)
+        elif isinstance(tgt, ast.Attribute):
+            key = _dotted(tgt)
+            if key:
+                if tainted:
+                    self.env.add(key)
+                else:
+                    self.env.discard(key)
+        elif isinstance(tgt, ast.Subscript):
+            self.taint(tgt.slice)
+            # storing a traced element taints the container; storing a
+            # host value into one slot does NOT untaint the rest
+            key = _dotted(tgt.value)
+            if key and tainted:
+                self.env.add(key)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._bind_target(e, tainted)
+        elif isinstance(tgt, ast.Starred):
+            self._bind_target(tgt.value, tainted)
+
+    def _bind_assign(self, targets, value) -> None:
+        if isinstance(value, (ast.Tuple, ast.List)):
+            elt_taints = [self.taint(e) for e in value.elts]
+            overall = any(elt_taints)
+        else:
+            elt_taints = None
+            overall = self.taint(value)
+        for tgt in targets:
+            if (elt_taints is not None
+                    and isinstance(tgt, (ast.Tuple, ast.List))
+                    and len(tgt.elts) == len(elt_taints)
+                    and not any(isinstance(e, ast.Starred)
+                                for e in tgt.elts)):
+                for e, t in zip(tgt.elts, elt_taints):
+                    self._bind_target(e, t)
+            else:
+                self._bind_target(tgt, overall)
+
+    # -- statements -------------------------------------------------------
+
+    def stmts(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._handle_def(st)
+        elif isinstance(st, ast.ClassDef):
+            for dec in st.decorator_list:
+                self.taint(dec)
+            # methods are plain functions; class-level state is untraced
+            child = _Scope(self.path, self.findings, self.registry,
+                           in_jit=False, boundary=self.boundary, env=set(),
+                           def_registry=self.def_registry)
+            child.stmts(st.body)
+            self._seen.update(child._seen)
+        elif isinstance(st, ast.Assign):
+            self._bind_assign(st.targets, st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._bind_assign([st.target], st.value)
+        elif isinstance(st, ast.AugAssign):
+            t = self.taint(st.value)
+            if isinstance(st.target, ast.Name):
+                prev = st.target.id in self.env
+            else:
+                key = _dotted(st.target)
+                prev = bool(key) and key in self.env
+            self._bind_target(st.target, t or prev)
+        elif isinstance(st, ast.Return):
+            self.taint(st.value)
+        elif isinstance(st, ast.Expr):
+            self.taint(st.value)
+        elif isinstance(st, (ast.If, ast.While)):
+            t = self.taint(st.test)
+            if self.in_jit and t and not _is_static_test(st.test):
+                kind = "if" if isinstance(st, ast.If) else "while"
+                self._emit(st, "jit-traced-branch",
+                           f"Python `{kind}` on a traced value inside jit")
+            self.stmts(st.body)
+            self.stmts(st.orelse)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            t = self.taint(st.iter)
+            if self.in_jit and t:
+                self._emit(st, "jit-traced-branch",
+                           "Python `for` over a traced value inside jit")
+            self._bind_target(st.target, t)
+            self.stmts(st.body)
+            self.stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                t = self.taint(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, t)
+            self.stmts(st.body)
+        elif isinstance(st, ast.Try) or st.__class__.__name__ == "TryStar":
+            self.stmts(st.body)
+            for h in st.handlers:
+                self.stmts(h.body)
+            self.stmts(st.orelse)
+            self.stmts(st.finalbody)
+        elif isinstance(st, ast.Assert):
+            t = self.taint(st.test)
+            if self.in_jit and t and not _is_static_test(st.test):
+                self._emit(st, "jit-traced-branch",
+                           "assert on a traced value inside jit")
+            if st.msg is not None:
+                self.taint(st.msg)
+        elif isinstance(st, ast.Raise):
+            self.taint(st.exc)
+            self.taint(st.cause)
+        elif isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    self.env.discard(tgt.id)
+        elif isinstance(st, ast.Match):
+            self.taint(st.subject)
+            for case in st.cases:
+                self.stmts(case.body)
+        # Import/Global/Nonlocal/Pass/Break/Continue: no taint flow
+
+    def _handle_def(self, st) -> None:
+        for d in st.args.defaults + [
+                d for d in st.args.kw_defaults if d is not None]:
+            self.taint(d)  # defaults evaluate in the enclosing scope
+        statics = None
+        for dec in st.decorator_list:
+            s = _jit_decorator_statics(dec)
+            if s is not None:
+                statics = s
+            else:
+                self.taint(dec)
+        if statics is None and st.name in self.def_registry:
+            statics = self.def_registry[st.name]
+        a = st.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        kwonly = [p.arg for p in a.kwonlyargs]
+        extra = [v.arg for v in (a.vararg, a.kwarg) if v is not None]
+        child_env = set(self.env)
+        for name in params + kwonly + extra:
+            child_env.discard(name)  # params shadow enclosing bindings
+        if statics is not None or self.in_jit:
+            # jit entry, or a helper defined inside a jit body (its args
+            # are traced at every call site)
+            names, nums = statics if statics is not None else (
+                frozenset(), frozenset())
+            if not self.in_jit:
+                # free variables of a jit ENTRY are trace-time constants
+                # (concrete module/closure values, e.g. solver INFEASIBLE
+                # = jnp.float32(...)) — only params carry tracers. Nested
+                # defs inside a jit body DO close over tracers, hence the
+                # inherit above for that case.
+                child_env = set()
+            for i, name in enumerate(params):
+                if name not in names and i not in nums:
+                    child_env.add(name)
+            for name in kwonly + extra:
+                if name not in names:
+                    child_env.add(name)
+            child = _Scope(self.path, self.findings, self.registry,
+                           in_jit=True, boundary=False, env=child_env,
+                           def_registry=self.def_registry)
+        else:
+            child = _Scope(self.path, self.findings, self.registry,
+                           in_jit=False, boundary=self.boundary,
+                           env=child_env, def_registry=self.def_registry)
+        child.stmts(st.body)
+        self._seen.update(child._seen)
+
+
+def run(tree: ast.AST, path: str, registry: dict, *,
+        def_registry: dict | None = None,
+        boundary: bool = True) -> list[Finding]:
+    findings: list[Finding] = []
+    scope = _Scope(path, findings, registry,
+                   in_jit=False, boundary=boundary, env=set(),
+                   def_registry=def_registry)
+    scope.stmts(tree.body)
+    return findings

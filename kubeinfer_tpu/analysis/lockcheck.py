@@ -1,0 +1,391 @@
+"""Lock-discipline AST pass (rule ``lock-discipline``).
+
+Infers, per class, which ``self._*`` attributes are written under
+``with self.<lock>`` and flags writes to the same attributes outside
+any lock — the exact shape of the PR 1 ``_promote_replica`` race. The
+pass is lexical, with two whole-class refinements that kill the obvious
+false positives:
+
+- **always-locked methods**: a method whose every intra-class call site
+  is inside a lock (or inside another always-locked method) runs under
+  the lock even though its own body shows none — e.g. batching's
+  ``_admit``, which is only called from the guarded ``_place`` region.
+  Computed as a fixpoint over the intra-class call graph.
+- **init-only methods**: writes in ``__init__``/``__post_init__`` and
+  in helpers reachable ONLY from them (``Store._replay``) happen before
+  the object is shared, so they are neither "locked" nor "unlocked".
+
+Lock attributes are discovered two ways: assignment from a lock factory
+(``threading.Lock/RLock/Condition`` or this package's
+``make_lock/make_rlock/make_condition``), and any bare ``with self.X:``
+context (covers locks passed in from outside). Writes include mutating
+method calls on the attribute (``self._events.append(...)``) — a list
+guarded by a condition is written by its mutators, not just by
+rebinding.
+
+Known blind spots, on purpose (a linter, not a prover): ``.acquire()``/
+``.release()`` pairs are not tracked (the codebase uses ``with``), a
+``Condition.wait()`` releasing the lock mid-block is ignored, and a
+closure defined under a lock is analyzed as UNLOCKED because nothing
+says it runs before the lock is dropped (thread targets usually don't).
+
+Module-level variant: module ``_lock`` globals guarding ``global``
+-declared writes (``native/lib.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from kubeinfer_tpu.analysis.core import Finding
+from kubeinfer_tpu.analysis.jitlint import _dotted
+
+__all__ = ["run"]
+
+_INIT_NAMES = {"__init__", "__post_init__"}
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+    "make_lock", "make_rlock", "make_condition",
+    "racecheck.make_lock", "racecheck.make_rlock", "racecheck.make_condition",
+}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "popleft",
+    "sort", "reverse",
+}
+# internally-synchronized objects: METHOD calls on them (Event.set/clear,
+# Queue.put) are safe anywhere, so they don't participate in lock
+# discipline. Rebinding the attribute itself still counts as a write.
+_SYNC_FACTORIES = {
+    "threading.Event", "Event", "threading.Semaphore", "Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "queue.Queue", "Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+}
+
+
+def _is_lock_factory(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    chain = _dotted(value.func) or ""
+    return chain in _LOCK_FACTORIES or chain.split(".")[-1] in (
+        "make_lock", "make_rlock", "make_condition")
+
+
+@dataclass
+class _Write:
+    attr: str
+    line: int
+    locked: bool
+    method: str
+
+
+@dataclass
+class _MethodInfo:
+    name: str
+    writes: list = field(default_factory=list)
+    # (callee_name, call_site_locked)
+    calls: list = field(default_factory=list)
+
+
+class _MethodWalker:
+    """One method body: records self-attr writes, self-method calls, and
+    the set of lock attributes it uses as ``with`` contexts."""
+
+    def __init__(self, info: _MethodInfo, lock_attrs: set, self_name: str,
+                 sync_attrs: set | None = None):
+        self.info = info
+        self.lock_attrs = lock_attrs
+        self.sync_attrs = sync_attrs or set()
+        self.self_name = self_name
+        self.depth = 0
+        self.with_attrs: set[str] = set()
+
+    def _self_attr(self, node) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == self.self_name):
+            return node.attr
+        return None
+
+    def _record_write_target(self, tgt) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._record_write_target(e)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._record_write_target(tgt.value)
+            return
+        node = tgt
+        # self._x[k] = v and self._x[k][j] = v all write self._x
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        attr = self._self_attr(node)
+        if attr is not None:
+            self.info.writes.append(
+                _Write(attr, tgt.lineno, self.depth > 0, self.info.name))
+
+    def _scan_expr(self, node) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute):
+                meth = sub.func.attr
+                base = self._self_attr(sub.func.value)
+                if (base is not None and meth in _MUTATORS
+                        and base not in self.sync_attrs):
+                    self.info.writes.append(
+                        _Write(base, sub.lineno, self.depth > 0,
+                               self.info.name))
+                callee = self._self_attr(sub.func)
+                if callee is not None:
+                    self.info.calls.append((callee, self.depth > 0))
+
+    def walk(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            # a closure may outlive the lock scope it was defined in, so
+            # its writes count as unlocked (see module docstring)
+            saved = self.depth
+            self.depth = 0
+            self.walk(st.body if not isinstance(st, ast.Lambda) else [])
+            self.depth = saved
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            holds = 0
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+                attr = self._self_attr(item.context_expr)
+                if attr is not None and (attr in self.lock_attrs
+                                         or _looks_like_lock(attr)):
+                    self.with_attrs.add(attr)
+                    holds += 1
+            self.depth += holds
+            self.walk(st.body)
+            self.depth -= holds
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+            for tgt in targets:
+                self._record_write_target(tgt)
+            if getattr(st, "value", None) is not None:
+                self._scan_expr(st.value)
+            return
+        # scan this statement's own expressions, then recurse into blocks
+        for fname, value in ast.iter_fields(st):
+            if isinstance(value, ast.expr):
+                self._scan_expr(value)
+            elif isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self.walk(value)
+                elif value and isinstance(value[0], ast.expr):
+                    for v in value:
+                        self._scan_expr(v)
+                elif value and isinstance(value[0], ast.excepthandler):
+                    for h in value:
+                        self.walk(h.body)
+                elif value and isinstance(value[0], ast.match_case):
+                    for c in value:
+                        self.walk(c.body)
+
+
+def _looks_like_lock(attr: str) -> bool:
+    tail = attr.rsplit("_", 1)[-1]
+    return tail in ("lock", "mu", "mutex", "cond", "cv", "sem")
+
+
+def _analyze_class(cls: ast.ClassDef, path: str, findings: list) -> None:
+    methods: dict[str, _MethodInfo] = {}
+    lock_attrs: set[str] = set()
+    sync_attrs: set[str] = set()
+    # pass 0: lock attrs + sync-primitive attrs from factory assignments
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        is_lock = _is_lock_factory(node.value)
+        is_sync = (isinstance(node.value, ast.Call)
+                   and (_dotted(node.value.func) or "") in _SYNC_FACTORIES)
+        if not (is_lock or is_sync):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                (lock_attrs if is_lock else sync_attrs).add(tgt.attr)
+    # pass 1: walk each method (lock attrs grow from `with self.X` uses,
+    # so a second sweep classifies writes against the full set)
+    walkers: list[_MethodWalker] = []
+    for st in cls.body:
+        if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _MethodInfo(st.name)
+        methods[st.name] = info
+        a = st.args
+        self_name = (a.posonlyargs + a.args)[0].arg \
+            if (a.posonlyargs + a.args) else "self"
+        w = _MethodWalker(info, lock_attrs, self_name, sync_attrs)
+        w.walk(st.body)
+        walkers.append((w, st, self_name))
+    for w, _st, _sn in walkers:
+        lock_attrs |= w.with_attrs
+    if not lock_attrs:
+        return
+    # re-walk now that the lock set is complete (first pass may have
+    # missed `with self._mu` regions discovered later)
+    methods = {}
+    for w, st, self_name in walkers:
+        info = _MethodInfo(st.name)
+        methods[st.name] = info
+        w2 = _MethodWalker(info, lock_attrs, self_name, sync_attrs)
+        w2.walk(st.body)
+
+    # init-only fixpoint: reachable ONLY from __init__/__post_init__
+    init_only: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, info in methods.items():
+            if name in init_only or name in _INIT_NAMES:
+                continue
+            sites = [caller for caller, cinfo in methods.items()
+                     for callee, _l in cinfo.calls if callee == name]
+            if sites and all(c in _INIT_NAMES or c in init_only
+                             for c in sites):
+                init_only.add(name)
+                changed = True
+
+    # always-locked fixpoint: every non-init call site holds the lock
+    always_locked: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, info in methods.items():
+            if (name in always_locked or name in _INIT_NAMES
+                    or name in init_only):
+                continue
+            sites = [(caller, locked)
+                     for caller, cinfo in methods.items()
+                     for callee, locked in cinfo.calls if callee == name
+                     if caller not in _INIT_NAMES and caller not in init_only]
+            if sites and all(locked or caller in always_locked
+                             for caller, locked in sites):
+                always_locked.add(name)
+                changed = True
+
+    by_attr: dict[str, list[_Write]] = {}
+    for name, info in methods.items():
+        if name in _INIT_NAMES or name in init_only:
+            continue
+        for wr in info.writes:
+            if wr.attr in lock_attrs:
+                continue
+            eff = _Write(wr.attr, wr.line,
+                         wr.locked or name in always_locked, name)
+            by_attr.setdefault(wr.attr, []).append(eff)
+    for attr, writes in by_attr.items():
+        locked_sites = [w for w in writes if w.locked]
+        unlocked_sites = [w for w in writes if not w.locked]
+        if locked_sites and unlocked_sites:
+            ref = locked_sites[0]
+            for w in unlocked_sites:
+                findings.append(Finding(
+                    path, w.line, "lock-discipline",
+                    f"{cls.name}.{w.method}: self.{attr} written without "
+                    f"the lock that guards it in {ref.method} "
+                    f"(line {ref.line})"))
+
+
+def _analyze_module_level(tree: ast.Module, path: str,
+                          findings: list) -> None:
+    mod_locks: set[str] = set()
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and _is_lock_factory(st.value):
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    mod_locks.add(tgt.id)
+    if not mod_locks:
+        return
+    writes: dict[str, list] = {}
+
+    def walk_fn(fn, globals_declared: set) -> None:
+        depth = 0
+
+        def stmt(st) -> None:
+            nonlocal depth
+            if isinstance(st, ast.Global):
+                globals_declared.update(st.names)
+                return
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk_fn(st, set())
+                return
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                holds = sum(
+                    1 for item in st.items
+                    if isinstance(item.context_expr, ast.Name)
+                    and item.context_expr.id in mod_locks)
+                depth += holds
+                for s in st.body:
+                    stmt(s)
+                depth -= holds
+                return
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                tgts = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for tgt in tgts:
+                    node = tgt
+                    while isinstance(node, ast.Subscript):
+                        node = node.value
+                    if (isinstance(node, ast.Name)
+                            and node.id in globals_declared):
+                        writes.setdefault(node.id, []).append(
+                            (tgt.lineno, depth > 0, fn.name))
+            for _f, value in ast.iter_fields(st):
+                if isinstance(value, list) and value \
+                        and isinstance(value[0], ast.stmt):
+                    for s in value:
+                        stmt(s)
+                elif isinstance(value, list) and value \
+                        and isinstance(value[0], ast.excepthandler):
+                    for h in value:
+                        for s in h.body:
+                            stmt(s)
+
+        # `global` declarations apply to the whole function scope, so
+        # collect them before classifying writes
+        pre: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                pre.update(node.names)
+        globals_declared.update(pre)
+        for s in fn.body:
+            stmt(s)
+
+    for st in tree.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(st, set())
+    for name, sites in writes.items():
+        locked = [s for s in sites if s[1]]
+        unlocked = [s for s in sites if not s[1]]
+        if locked and unlocked:
+            for line, _l, meth in unlocked:
+                findings.append(Finding(
+                    path, line, "lock-discipline",
+                    f"{meth}: global {name} written without the module "
+                    f"lock that guards it in {locked[0][2]} "
+                    f"(line {locked[0][0]})"))
+
+
+def run(tree: ast.AST, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _analyze_class(node, path, findings)
+    if isinstance(tree, ast.Module):
+        _analyze_module_level(tree, path, findings)
+    return findings

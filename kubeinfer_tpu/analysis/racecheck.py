@@ -1,0 +1,228 @@
+"""Runtime lock-order sentinel (armed by ``KUBEINFER_RACECHECK=1``).
+
+The static lock-discipline pass (analysis/lockcheck.py) proves that
+attributes guarded by a lock are never written outside it, but it cannot
+see ACQUISITION ORDER: two locks each used correctly in isolation can
+still deadlock when thread A takes them as (a, b) and thread B as
+(b, a). This module instruments the lock-creation sites the package
+already has (``make_lock``/``make_condition`` factories) and builds the
+runtime lock-acquisition-order graph: an edge a→b means some thread
+acquired b while holding a. A cycle in that graph is deadlock
+*potential* — reported even if the interleaving never actually hung,
+which is exactly what a chaos tier wants (the hang itself is a
+one-in-a-thousand schedule; the edge pair is deterministic).
+
+Also records per-lock max held duration and acquisition counts, so a
+lock held across a jit compile (the batching stop()-vs-compile hazard)
+shows up as a number, not a hunch.
+
+Off (the default) the factories return plain ``threading`` primitives —
+zero overhead in production. The chaos tier (tests/test_chaos.py) arms
+the sentinel for every scenario and asserts the graph stays acyclic.
+No reference-file citation: the reference has no race tooling at all
+(its election logic is untested, SURVEY.md §4) — this is new mechanism.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+__all__ = [
+    "armed",
+    "make_lock",
+    "make_rlock",
+    "make_condition",
+    "TrackedLock",
+    "REGISTRY",
+]
+
+
+def armed() -> bool:
+    """Whether the sentinel is on (checked at lock CREATION time, so the
+    env var must be set before the guarded component is constructed)."""
+    return os.environ.get("KUBEINFER_RACECHECK", "") not in ("", "0", "false")
+
+
+class _Registry:
+    """Process-global acquisition-order graph + hold-time stats.
+
+    The graph is keyed by lock *name* (the creation-site label), not
+    instance: two Store instances' ``_lock``s are the same node, which
+    is the right granularity for order discipline — the code path, not
+    the object, defines the ordering contract.
+    """
+
+    def __init__(self) -> None:
+        # guards the shared maps; thread-local held stacks need no lock
+        self._mu = threading.Lock()
+        # (outer_name, inner_name) -> one example acquisition stack
+        self._edges: dict[tuple[str, str], str] = {}
+        self._hold_max: dict[str, float] = {}
+        self._acquires: dict[str, int] = {}
+        self._held = threading.local()
+
+    # -- per-thread held stack -------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def on_acquired(self, lock: "TrackedLock") -> None:
+        st = self._stack()
+        if st:
+            # one example traceback per NEW edge; skip the two sentinel
+            # frames (this method + TrackedLock.acquire)
+            sample = None
+            with self._mu:
+                for outer, _t0 in st:
+                    key = (outer.name, lock.name)
+                    if outer.name != lock.name and key not in self._edges:
+                        if sample is None:
+                            sample = "".join(
+                                traceback.format_stack(limit=10)[:-2]
+                            )
+                        self._edges[key] = sample
+                self._acquires[lock.name] = (
+                    self._acquires.get(lock.name, 0) + 1
+                )
+        else:
+            with self._mu:
+                self._acquires[lock.name] = (
+                    self._acquires.get(lock.name, 0) + 1
+                )
+        st.append((lock, time.monotonic()))
+
+    def on_released(self, lock: "TrackedLock") -> None:
+        st = self._stack()
+        # locks may release out of LIFO order (and, for plain Locks, even
+        # on a different thread — then there is nothing to pop here)
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] is lock:
+                held_for = time.monotonic() - st[i][1]
+                del st[i]
+                with self._mu:
+                    if held_for > self._hold_max.get(lock.name, 0.0):
+                        self._hold_max[lock.name] = held_for
+                return
+
+    # -- reporting --------------------------------------------------------
+
+    def edges(self) -> dict[tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Cycles in the acquisition-order graph (each a node list with
+        the start repeated at the end). Any cycle = deadlock potential."""
+        with self._mu:
+            adj: dict[str, list[str]] = {}
+            for a, b in self._edges:
+                adj.setdefault(a, []).append(b)
+        out: list[list[str]] = []
+        seen_cycles: set[tuple[str, ...]] = set()
+        visiting: list[str] = []
+        on_path: set[str] = set()
+        done: set[str] = set()
+
+        def dfs(node: str) -> None:
+            visiting.append(node)
+            on_path.add(node)
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = visiting[visiting.index(nxt):] + [nxt]
+                    # canonicalize so A→B→A and B→A→B dedupe
+                    canon = tuple(sorted(cyc[:-1]))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        out.append(cyc)
+                elif nxt not in done:
+                    dfs(nxt)
+            on_path.discard(node)
+            visiting.pop()
+            done.add(node)
+
+        for node in list(adj):
+            if node not in done:
+                dfs(node)
+        return out
+
+    def report(self) -> dict:
+        cycles = self.cycles()
+        with self._mu:
+            return {
+                "edges": sorted(self._edges),
+                "cycles": cycles,
+                "hold_max_s": dict(self._hold_max),
+                "acquires": dict(self._acquires),
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._hold_max.clear()
+            self._acquires.clear()
+        # held stacks are thread-local snapshots of LIVE state; resetting
+        # mid-hold would corrupt pairing, so only the aggregates clear
+
+
+REGISTRY = _Registry()
+
+
+class TrackedLock:
+    """Lock/RLock wrapper feeding the registry.
+
+    Duck-types the ``threading.Lock`` surface (acquire/release/context
+    manager/locked) closely enough that ``threading.Condition`` accepts
+    it as its underlying lock (Condition only needs acquire/release; its
+    ``_is_owned`` fallback probes with ``acquire(0)``).
+    """
+
+    def __init__(self, name: str, factory=threading.Lock) -> None:
+        self.name = name
+        self._inner = factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            REGISTRY.on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        REGISTRY.on_released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name!r})"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — tracked when the sentinel is armed."""
+    return TrackedLock(name) if armed() else threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — tracked when the sentinel is armed."""
+    return TrackedLock(name, threading.RLock) if armed() else threading.RLock()
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose underlying lock is tracked when
+    the sentinel is armed (waits release/reacquire through the wrapper,
+    so hold times exclude the wait)."""
+    if armed():
+        return threading.Condition(TrackedLock(name))
+    return threading.Condition()

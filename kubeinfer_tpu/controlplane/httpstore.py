@@ -58,6 +58,7 @@ from kubeinfer_tpu.resilience import (
     transient_http,
 )
 from kubeinfer_tpu.resilience import faultpoints
+from kubeinfer_tpu.analysis.racecheck import make_condition
 from kubeinfer_tpu.utils.httpbase import (
     BaseEndpointHandler,
     client_ssl_context,
@@ -97,7 +98,7 @@ class StoreServer:
         self._events: collections.deque[WatchEvent] = collections.deque(
             maxlen=EVENT_LOG_SIZE
         )
-        self._events_cond = threading.Condition()
+        self._events_cond = make_condition("httpstore.StoreServer._events_cond")
         self._watch = store.watch()
         self._pump = threading.Thread(
             target=self._pump_events, daemon=True, name="store-event-pump"

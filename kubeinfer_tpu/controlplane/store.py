@@ -29,6 +29,7 @@ import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Iterator
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 # Journal entries between snapshot compactions. Control-plane mutation
 # rates are a few per tick, so compaction is rare; the journal stays
@@ -92,7 +93,7 @@ class Store:
     """
 
     def __init__(self, data_dir: str | os.PathLike | None = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.Store._lock")
         self._objects: dict[Key, dict[str, Any]] = {}
         self._rv = 0
         self._watchers: list[_Watcher] = []

@@ -41,6 +41,7 @@ from kubeinfer_tpu.controlplane.store import (
 )
 from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 # Store failures a renew tick must survive (see node_agent.py
 # STORE_TRANSIENT: OSError covers urllib errors and the breaker's
@@ -126,7 +127,7 @@ class LeaseManager:
         self._duration = duration_s
         self._renew_interval = renew_interval_s
         self._retry = retry_interval_s
-        self._mu = threading.Lock()  # guards _is_leader (election.go:26-27)
+        self._mu = make_lock("lease.LeaseManager._mu")  # guards _is_leader (election.go:26-27)
         self._is_leader = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

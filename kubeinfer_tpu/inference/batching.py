@@ -36,6 +36,7 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
 from kubeinfer_tpu.inference.model import Params, forward
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 # --- device state ----------------------------------------------------------
 
@@ -337,7 +338,7 @@ class ContinuousEngine:
         # guards _slot_req and request result mutation between the
         # scheduler loop and stop()'s cleanup (the join below can time
         # out behind a long jit compile, leaving both threads live)
-        self._lock = threading.Lock()
+        self._lock = make_lock("batching.ContinuousEngine._lock")
 
     # -- public API -------------------------------------------------------
 
@@ -488,6 +489,7 @@ class ContinuousEngine:
         )
         self._slot_req[slot] = req
         # the prefill already produced the first generated token
+        # lint: allow[host-sync] admission boundary: the first token must reach the request result now
         first = int(self._state.last_token[slot])
         req.out_tokens.append(first)
         self._maybe_retire(slot)
@@ -711,9 +713,11 @@ class ContinuousEngine:
             if busy:
                 # device step outside the lock (it can block on a
                 # compile; stop() must still be able to fail the slots)
+                # lint: allow[lock-discipline] scheduler thread is the only _state writer; see comment above
                 self._state, tokens = _decode_step(
                     self.params, self._state, self.cfg
                 )
+                # lint: allow[host-sync] per-step decode boundary: tokens feed the Python result queues
                 toks = np.asarray(tokens)
                 with self._lock:
                     for slot in range(self.n_slots):

@@ -538,6 +538,7 @@ class Engine:
                 # lockstep (within a group the batch axis decorrelates)
                 jax.random.fold_in(jax.random.PRNGKey(seed), L),
             )
+            # lint: allow[host-sync] serving boundary: one readback per length bucket
             toks_out[idx] = np.asarray(toks)
-            lens_out[idx] = np.asarray(glens)
+            lens_out[idx] = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
         return GenerationResult(toks_out, lens_out)

@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from kubeinfer_tpu.utils.jaxcompat import shard_map
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -94,7 +95,7 @@ def _ep_fn(mesh: Mesh, top_k: int):
         return lax.psum(out, "ep")
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(

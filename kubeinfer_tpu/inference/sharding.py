@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from kubeinfer_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeinfer_tpu.inference.config import ModelConfig
@@ -176,7 +177,7 @@ def forward_sequence_parallel(
         return out
 
     shard_fwd = jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(body),
             mesh=mesh,
             in_specs=(param_specs_replicated(cfg, params), P(None, "sp")),

@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from kubeinfer_tpu.utils.jaxcompat import shard_map
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -146,7 +147,7 @@ def sp_prefill(
         )
         for _ in range(cfg.num_hidden_layers)
     ]
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(pspecs, P(None, "sp"), P()),
@@ -261,6 +262,7 @@ class SPEngine:
                 jnp.float32(repetition_penalty),
                 jax.random.fold_in(jax.random.PRNGKey(seed), L),
             )
+            # lint: allow[host-sync] serving boundary: one readback per length bucket
             toks_out[idx] = np.asarray(toks)
-            lens_out[idx] = np.asarray(glens)
+            lens_out[idx] = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
         return GenerationResult(toks_out, lens_out)

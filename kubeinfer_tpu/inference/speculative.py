@@ -593,6 +593,7 @@ class SpeculativeEngine:
             jnp.int32(g.eos_id), g.temperature, g.top_k, g.top_p,
         )
         g.rounds_run += 1
+        # lint: allow[host-sync] decode exit: the all-slots-done flag drives the Python scheduling loop
         return bool(np.asarray(g.state[7]).all())
 
     def finish_group(self, g: "SpecGroup") -> GenerationResult:

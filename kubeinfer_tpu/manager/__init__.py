@@ -39,6 +39,7 @@ from kubeinfer_tpu.controlplane.httpstore import (
 from kubeinfer_tpu.controlplane.store import Store
 from kubeinfer_tpu.coordination.lease import LeaseManager
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+from kubeinfer_tpu.analysis.racecheck import make_rlock
 from kubeinfer_tpu.utils.httpbase import (
     BaseEndpointHandler,
     token_matches,
@@ -158,8 +159,10 @@ class Manager:
         # the replica thread and publishes store/store_server/_local_store,
         # which stop() tears down — without mutual exclusion a stop racing
         # a promotion can leak a freshly bound StoreServer (socket held
-        # forever) or close a store mid-publication.
-        self._promote_mu = threading.Lock()
+        # forever) or close a store mid-publication. Reentrant because
+        # promotion calls _start_election, which takes the lock itself so
+        # the leader-elect boot path is equally guarded.
+        self._promote_mu = make_rlock("manager.Manager._promote_mu")
 
         self._replica = None
         if cfg.store_connect:
@@ -321,11 +324,18 @@ class Manager:
             f"manager-{socket.gethostname()}-{os.getpid()}-"
             f"{secrets.token_hex(4)}"
         )
-        self._lease = LeaseManager(
-            self.store, self.cfg.namespace, MANAGER_LEASE,
-            identity=identity, clock=self._clock, **timing_kw,
-        )
-        self._lease.start(self._on_elected, self._on_lost)
+        # _lease is published under _promote_mu everywhere it is written
+        # (_promote_replica swaps it during failover); the boot path must
+        # hold the same lock or a stop() racing startup can observe a
+        # half-published lease (found by analysis lock-discipline).
+        with self._promote_mu:
+            if self._stop.is_set():
+                return
+            self._lease = LeaseManager(
+                self.store, self.cfg.namespace, MANAGER_LEASE,
+                identity=identity, clock=self._clock, **timing_kw,
+            )
+            self._lease.start(self._on_elected, self._on_lost)
 
     def _promote_replica(self) -> bool:
         """Serve the replica on the store frontend address (called from

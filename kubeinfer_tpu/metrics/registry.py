@@ -11,13 +11,14 @@ Exposition follows the Prometheus text format (what the reference's secured
 
 from __future__ import annotations
 
-import threading
 from typing import Sequence
+
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 
 class Registry:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Registry._lock")
         self._collectors: list["_Collector"] = []
 
     def register(self, c: "_Collector") -> None:
@@ -67,7 +68,7 @@ class _Collector:
         self.name = name
         self.help = help_
         self.label_names = tuple(labels)
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"metrics.{name}._lock")
         if registry is not None:
             registry.register(self)
 

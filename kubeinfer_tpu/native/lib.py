@@ -14,13 +14,14 @@ import subprocess
 import threading
 
 import numpy as np
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 ABI_VERSION = 1
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libkubeinfer_native.so"
 
-_lock = threading.Lock()
+_lock = make_lock("native.lib._lock")
 _lib: ctypes.CDLL | None = None
 
 

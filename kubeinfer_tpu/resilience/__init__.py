@@ -45,6 +45,7 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.metrics.registry import (
     breaker_state,
     breaker_transitions_total,
@@ -229,7 +230,7 @@ class CircuitBreaker:
         self._threshold = max(1, failure_threshold)
         self._reset = reset_timeout_s
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = make_lock(f"resilience.CircuitBreaker[{edge}]._mu")
         self._state = "closed"
         self._failures = 0
         self._opened_at = 0.0

@@ -45,6 +45,7 @@ import urllib.error
 from dataclasses import dataclass, field
 
 from kubeinfer_tpu.metrics.registry import fault_injections_total
+from kubeinfer_tpu.analysis.racecheck import make_lock
 
 __all__ = ["FaultSpec", "FaultRegistry", "REGISTRY", "fire", "mangle"]
 
@@ -92,7 +93,7 @@ class FaultRegistry:
     scenario replays bit-identically."""
 
     def __init__(self) -> None:
-        self._mu = threading.Lock()
+        self._mu = make_lock("faultpoints.FaultState._mu")
         self._specs: list[FaultSpec] = []
         self._rng = random.Random(0)
         self._env_checked = False

@@ -340,6 +340,7 @@ class JaxBackend(SchedulerBackend):
             # round trip, which under a remote PJRT relay costs ~65-100ms.
             # Inside the profile context: dispatch is async, so the trace
             # must stay open until this sync or device activity is lost.
+            # lint: allow[host-sync] the ONE deliberate readback described above
             node_host, rounds_host = jax.device_get((out.node, out.rounds))
         if perm is None:
             assignment = np.asarray(node_host[: req.num_jobs], np.int32)

@@ -60,6 +60,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernels run on both sides of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 TILE_N = 128
 # 1024 measured best on v5e: full HBM bandwidth on the S sweep (746GB/s vs
 # 521GB/s at 512 — per-grid-step overhead bites below 1024) while keeping
@@ -932,7 +938,7 @@ def mega_solve_pallas(
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_MEGA_VMEM_LIMIT
         ),
     )(
@@ -1306,7 +1312,7 @@ def auction_solve(
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_MEGA_VMEM_LIMIT
         ),
     )(

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from kubeinfer_tpu.analysis.racecheck import make_condition
 
 
 class Clock:
@@ -51,7 +52,7 @@ class SimulatedClock(Clock):
 
     def __init__(self, start: float = 0.0):
         self._now = start
-        self._cond = threading.Condition()
+        self._cond = make_condition("clock.SimulatedClock._cond")
 
     def now(self) -> float:
         with self._cond:

@@ -1,0 +1,51 @@
+"""Version-compat aliases for jax API promotions/renames.
+
+jax promoted ``shard_map`` from ``jax.experimental.shard_map`` to the
+top-level namespace; images on either side of the promotion must run
+the same source (the pallas ``TPUCompilerParams`` -> ``CompilerParams``
+rename is handled locally in solver/pallas_kernels.py the same way).
+Alias once here so call sites stay uniform — the analyzer's jit-entry
+detection matches the bare ``shard_map`` name as well as the dotted
+form (analysis/jitlint.py), so linting is unaffected.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, **kwargs):
+        # pre-promotion jax also predates lax.pcast, so bodies that
+        # declare varying-ness via pcast (ring attention's scan carries)
+        # trip the old replication-type checker — its own error message
+        # prescribes check_rep=False. Newer jax keeps full checking.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, **kwargs)
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name) -> int:
+        # the pre-axis_size idiom: psum of a Python constant
+        # constant-folds against the static mesh, so the result is a
+        # plain int usable for Python loop bounds inside shard_map
+        return lax.psum(1, axis_name)
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+else:
+    def pcast(x, axis_name, *, to):
+        # pre-varying-axes jax has no manual-axes type system for
+        # shard_map bodies, so there is nothing to cast — the values are
+        # already (implicitly) varying and identity is exact
+        return x
+
+__all__ = ["shard_map", "axis_size", "pcast"]

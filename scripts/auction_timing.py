@@ -40,11 +40,13 @@ def main():
     )
     t0 = time.time()
     a_pallas = solve_auction(p, accel="pallas")
-    asg_p = np.asarray(a_pallas.node)
+    asg_p = np.asarray(a_pallas.node)  # lint: allow[host-sync] timing-harness readback
+    # lint: allow[host-sync] timing-harness readback
     print(f"pallas compile+run {time.time()-t0:.1f}s; placed={int(a_pallas.placed)} iters={int(a_pallas.rounds)}")
     t0 = time.time()
     a_jnp = solve_auction(p, accel="jnp")
-    asg_j = np.asarray(a_jnp.node)
+    asg_j = np.asarray(a_jnp.node)  # lint: allow[host-sync] timing-harness readback
+    # lint: allow[host-sync] timing-harness readback
     print(f"jnp    compile+run {time.time()-t0:.1f}s; placed={int(a_jnp.placed)} iters={int(a_jnp.rounds)}")
     same = np.array_equal(asg_p, asg_j)
     print("bitwise assigned parity:", same)

@@ -78,6 +78,8 @@ _COMPONENT_BY_PREFIX = (
     # resilience layer + fault-injection scenarios (`make test-chaos`);
     # pure controlplane work — runs under the same virtual CPU mesh
     (("test_chaos", "test_resilience"), "chaos"),
+    # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/)
+    (("test_static_analysis",), "analysis"),
 )
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from kubeinfer_tpu.utils.jaxcompat import shard_map
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
@@ -122,7 +123,7 @@ class TestRingAttention:
         devices = np.asarray(jax.devices()[:8]).reshape(8)
         mesh = Mesh(devices, axis_names=("sp",))
         ring = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
                 mesh=mesh,
                 in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
@@ -145,7 +146,7 @@ class TestRingAttention:
         devices = np.asarray(jax.devices()[:4]).reshape(4)
         mesh = Mesh(devices, axis_names=("sp",))
         ring = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda q, k, v: ring_attention(
                     q, k, v, axis_name="sp", causal=False
                 ),
@@ -216,7 +217,7 @@ class TestManualTPMoE:
             return out
 
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh,
                 in_specs=(pspecs, P()),
                 out_specs=P(None, None, "tp"),  # lm_head vocab-sharded
