@@ -142,7 +142,11 @@ def _decode_step(
     mask = jnp.broadcast_to(mask, (B, 1, S))
 
     # model.forward handles per-row cache offsets natively (decoder_layer
-    # vmaps the cache write when cache_offset is a vector)
+    # scatter-writes when cache_offset is a vector); on TPU the decode
+    # kernel reads only each slot's live KV tiles (lengths == the mask's
+    # live set), dense fallback elsewhere
+    from kubeinfer_tpu.inference.flash_attention import decode_attention_auto
+
     logits, caches = forward(
         params,
         state.last_token[:, None],
@@ -151,6 +155,9 @@ def _decode_step(
         attn_mask=mask,
         kv_caches=list(zip(state.caches_k, state.caches_v)),
         cache_offset=state.offset,
+        attn_fn=lambda q, k, v, m: decode_attention_auto(
+            q, k, v, state.offset + 1, m
+        ),
     )
     new_k = [c[0] for c in caches]
     new_v = [c[1] for c in caches]

@@ -30,6 +30,7 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.flash_attention import (
     attention_auto,
+    decode_attention_auto,
     flash_attention_ragged,
     flash_available,
 )
@@ -344,7 +345,7 @@ def decode_scan(
     caches,  # per-layer (k, v) with the prompt's KV already written
     next_logits: jax.Array,  # f32[B, V] logits at each row's last prompt pos
     prompt: jax.Array,  # i32[B, T_bucket] (repetition-penalty seed state)
-    prompt_len: jax.Array,  # i32[B]; all rows must share one length
+    prompt_len: jax.Array,  # i32[B]; rows may be length-ragged
     max_new: int,
     cache_len: int,
     eos_id: jax.Array,
@@ -372,15 +373,21 @@ def decode_scan(
     def step(carry, key):
         caches, tok, offset, done, seen = carry
         step_mask = (jnp.arange(cache_len)[None, None, :] <= offset[:, None, None])
+        # per-row offsets: each row writes its token at its OWN cache
+        # position (batched scatter in decoder_layer) and attends to its
+        # own live prefix — one dispatch decodes a length-ragged batch.
+        # On TPU the decode kernel reads only each row's live KV tiles
+        # (lengths operand == the mask's live set, offset + 1); the mask
+        # remains the dense fallback operand.
         logits, caches = forward(
             params, tok[:, None], cfg,
             positions=offset[:, None],
             attn_mask=jnp.broadcast_to(step_mask, (B, 1, cache_len)),
             kv_caches=caches,
-            # dynamic_update_slice takes ONE offset for the whole batch,
-            # so every row must share it — generate() guarantees this by
-            # solving each distinct prompt length as its own batch.
-            cache_offset=offset[0],
+            cache_offset=offset,
+            attn_fn=lambda q, k, v, mask: decode_attention_auto(
+                q, k, v, offset + 1, mask
+            ),
         )
         nxt = sample(logits[:, 0], key, seen)
         seen = record_seen(seen, nxt, rep_penalty)
@@ -503,9 +510,11 @@ class Engine:
         """Batch generation, exact for ragged prompts.
 
         Prompts pad to a shared bucket for the prefill (pad columns
-        masked via prompt_len); the decode scan requires one shared
-        cache offset per call, so rows are grouped by distinct prompt
-        length and each group solves in its own jit invocation.
+        masked via prompt_len) and the whole batch — mixed lengths
+        included — decodes in ONE jit invocation: decode_scan carries a
+        per-row cache offset, so no per-length grouping (the pre-ragged
+        engine fragmented mixed traffic into per-length micro-batches,
+        forfeiting the batch-scaling BENCH_r04 measured).
         """
         if not prompts:
             return GenerationResult(
@@ -515,30 +524,22 @@ class Engine:
         padded, lens, cache_len = prepare_prompts(
             prompts, max_new_tokens, self.max_cache_len
         )
-
-        toks_out = np.zeros((B, max_new_tokens), np.int32)
-        lens_out = np.zeros((B,), np.int32)
-        for L in sorted(set(lens.tolist())):
-            idx = np.nonzero(lens == L)[0]
-            toks, glens = _generate_jit(
-                self.params,
-                jnp.asarray(padded[idx]),
-                jnp.asarray(lens[idx]),
-                self.cfg,
-                max_new_tokens,
-                cache_len,
-                prefill_chunk_for(len(idx), int(padded.shape[1])),
-                jnp.int32(eos_id),
-                jnp.float32(temperature),
-                jnp.int32(top_k),
-                jnp.float32(top_p),
-                jnp.float32(repetition_penalty),
-                # fold the group length in: identical keys across length
-                # groups would sample rows of different groups in
-                # lockstep (within a group the batch axis decorrelates)
-                jax.random.fold_in(jax.random.PRNGKey(seed), L),
-            )
-            # lint: allow[host-sync] serving boundary: one readback per length bucket
-            toks_out[idx] = np.asarray(toks)
-            lens_out[idx] = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
+        toks, glens = _generate_jit(
+            self.params,
+            jnp.asarray(padded),
+            jnp.asarray(lens),
+            self.cfg,
+            max_new_tokens,
+            cache_len,
+            prefill_chunk_for(B, int(padded.shape[1])),
+            jnp.int32(eos_id),
+            jnp.float32(temperature),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+            jnp.float32(repetition_penalty),
+            jax.random.PRNGKey(seed),
+        )
+        # lint: allow[host-sync] serving boundary: one readback per batch
+        toks_out = np.asarray(toks)
+        lens_out = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
         return GenerationResult(toks_out, lens_out)

@@ -28,6 +28,13 @@ Two mask sources share one softmax body (``_softmax_fold``):
   in-kernel variants; this remains for exotic masks (blockwise-sparse
   experiments, bidirectional scoring).
 
+A third variant serves the decode step: ``decode_attention`` (T == 1,
+per-row live lengths as a scalar-prefetch operand) reads only each
+row's live KV tiles — the BlockSpec index_map clamps past the length so
+the dead tiles' DMAs are elided, not just their compute. Its twin
+``decode_attention_jnp`` shares ``_fold_tile_math`` and is bit-identical
+(parity in tests/test_flash_attention.py).
+
 Layout: GQA folds the (T, G) axes into MXU rows — q becomes
 [B*n_kv, T*G, D], each S tile is one [T_q*G, D] x [D, S_k] matmul plus
 one [T_q*G, S_k] x [S_k, D] matmul, and the mask penalty (which depends
@@ -72,6 +79,53 @@ TILE_T = 256  # query positions per tile (rows = TILE_T * G)
 TILE_S = 512  # key/value positions per tile
 
 
+def _fold_tile_math(
+    q,  # [TqG, D] folded (t, g) query rows
+    k,  # [Sk, D]
+    v,  # [Sk, D]
+    pen,  # f32[Tq, Sk]: 0 = attend, -1e30 = masked
+    m_prev,  # f32[TqG, 1]
+    l_prev,  # f32[TqG, 1]
+    acc_prev,  # f32[TqG, D]
+    *,
+    groups: int,
+    scale: float,
+):
+    """The pure value-level online-softmax step: one (q-tile, s-tile)
+    fold of the running (m, l, acc) state. Shared between the Pallas
+    kernels (via _softmax_fold / _decode_attn_kernel) and the decode
+    jnp twin — bit-identity between a kernel and its twin is only
+    checkable if both run THIS function, not a re-derivation (the same
+    contract as pallas_kernels.mega_rounds_jnp sharing the round math).
+
+    Batched use: the decode twin vmaps this over the B*n_kv axis, so
+    every operand here is one grid instance's tile."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [TqG, Sk]
+    # Masking as an f32 additive penalty broadcast across the G
+    # subrows. Mosaic cannot relayout i1 vectors ("unsupported shape
+    # cast" on a bool [Tq, 1, Sk] broadcast), so rank changes happen
+    # on f32 values; the add is exact (|s| << 1e23, so s + -1e30
+    # rounds to -1e30).
+    tq, sk = pen.shape
+    s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
+        tq * groups, sk
+    )
+
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)  # [TqG, Sk] f32
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TqG, D]
+    acc_new = acc_prev * alpha + pv
+    return m_new, l_new, acc_new
+
+
 def _softmax_fold(
     q_ref,  # [1, TILE_T * G, D] folded (t, g) query rows
     k_ref,  # [1, TILE_S, D]
@@ -112,32 +166,13 @@ def _softmax_fold(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _fold():
-        q = q_ref[0]  # [TqG, D]
-        k = k_ref[0]  # [Sk, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [TqG, Sk]
-        # Masking as an f32 additive penalty broadcast across the G
-        # subrows. Mosaic cannot relayout i1 vectors ("unsupported shape
-        # cast" on a bool [Tq, 1, Sk] broadcast), so rank changes happen
-        # on f32 values; the add is exact (|s| << 1e23, so s + -1e30
-        # rounds to -1e30).
-        tq, sk = pen.shape
-        s = (s.reshape(tq, groups, sk) + pen[:, None, :]).reshape(
-            tq * groups, sk
+        m_new, l_new, acc_new = _fold_tile_math(
+            q_ref[0], k_ref[0], v_ref[0], pen,
+            m_scr[:], l_scr[:], acc_scr[:],
+            groups=groups, scale=scale,
         )
-
-        m_prev = m_scr[:]  # [TqG, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)  # [TqG, Sk] f32
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [TqG, D]
-        acc_scr[:] = acc_scr[:] * alpha + pv
+        l_scr[:] = l_new
+        acc_scr[:] = acc_new
         m_scr[:] = m_new
 
     if active is None:
@@ -355,6 +390,228 @@ def flash_attention_ragged(
         ],
         q, k, v, tile_t, tile_s, interpret, "flash_attention_ragged",
     )
+
+
+# --- batched decode attention (T == 1, per-row live lengths) ---------------
+
+
+def _decode_attn_kernel(
+    len_ref,  # scalar-prefetch i32[B]: per-row live lengths
+    q_ref,  # [1, G, D] — the row's single query, groups as MXU rows
+    k_ref,  # [1, tile_s, D]
+    v_ref,  # [1, tile_s, D]
+    o_ref,  # [1, G, D] out
+    m_scr,  # f32[G, 1]
+    l_scr,  # f32[G, 1]
+    acc_scr,  # f32[G, D]
+    *,
+    groups: int,
+    scale: float,
+    s_tiles: int,
+    tile_s: int,
+    n_kv: int,
+):
+    """One decode step's attention for one (batch row, kv head): sweep
+    the row's live S tiles with the shared fold. The grid is 2D
+    (B*n_kv, s_tiles) — T == 1 makes the q-tile axis pointless — and
+    the per-row length lives in the scalar-prefetch operand so the k/v
+    BlockSpec index_map can clamp DMAs past the live length (see
+    decode_attention). Tiles past the length are also compute-skipped;
+    both are bit-identical no-ops (see _softmax_fold's active note:
+    row_len == 0 rows keep every tile live to preserve their defined
+    uniform-average output)."""
+    row_len = len_ref[pl.program_id(0) // n_kv]
+    ts = pl.program_id(1)  # innermost: S sweep with resident scratch
+
+    @pl.when(ts == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when((ts == 0) | (row_len == 0) | (ts * tile_s < row_len))
+    def _fold():
+        s_pos = ts * tile_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, tile_s), 1
+        )
+        pen = jnp.where(s_pos < row_len, 0.0, -1e30)
+        m_new, l_new, acc_new = _fold_tile_math(
+            q_ref[0], k_ref[0], v_ref[0], pen,
+            m_scr[:], l_scr[:], acc_scr[:],
+            groups=groups, scale=scale,
+        )
+        l_scr[:] = l_new
+        acc_scr[:] = acc_new
+        m_scr[:] = m_new
+
+    @pl.when(ts == s_tiles - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def decode_attention(
+    q: jax.Array,  # [B, 1, n_heads, D] — one new token per row
+    k: jax.Array,  # [B, S, n_kv, D] padded KV cache
+    v: jax.Array,  # [B, S, n_kv, D]
+    lengths: jax.Array,  # i32[B]: live entries per row (offset + 1)
+    *,
+    tile_s: int = TILE_S,
+    interpret: bool = False,
+) -> jax.Array:
+    """Batched ragged decode attention: each row attends to its own
+    first ``lengths[b]`` cache slots. HBM traffic is the point — the
+    lengths ride in as a scalar-prefetch operand, so the k/v index_map
+    below clamps the block index past each row's live length and
+    Pallas elides the repeated-block DMAs: a row that is 1k tokens
+    into a 128k cache reads ~1k positions, not 128k. The dense path
+    this replaces reads the full padded cache every step for every
+    row. Twin: decode_attention_jnp (bit-identical, parity-tested)."""
+    B, T, n_heads, D = q.shape
+    if T != 1:
+        raise ValueError(f"decode_attention is T == 1 only; got T={T}")
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_s = min(tile_s, S)
+    if S % tile_s:
+        raise ValueError(
+            f"decode_attention needs S divisible by {tile_s}; got S={S} "
+            "(use decode_attention_auto for fallback)"
+        )
+    s_tiles = S // tile_s
+
+    qf = q.reshape(B, n_kv, G, D).reshape(B * n_kv, G, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * n_kv, S, D)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    def _kv_map(bh, ts, lens_ref, n_kv=n_kv, tile_s=tile_s):
+        # Clamp the S block index to the row's last live tile: Pallas
+        # skips the DMA when consecutive steps name the same block, so
+        # dead tiles cost nothing. row_len == 0 rows must NOT clamp —
+        # their (defined) output is the uniform average over the real
+        # cache contents, so they read every true tile.
+        rl = lens_ref[bh // n_kv]
+        live_last = jnp.maximum(rl - 1, 0) // tile_s
+        return (bh, jnp.where(rl == 0, ts, jnp.minimum(ts, live_last)), 0)
+
+    q_spec = pl.BlockSpec(
+        (1, G, D), lambda bh, ts, lens_ref: (bh, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec = pl.BlockSpec((1, tile_s, D), _kv_map, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_attn_kernel, groups=G, scale=1.0 / float(D) ** 0.5,
+            s_tiles=s_tiles, tile_s=tile_s, n_kv=n_kv,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * n_kv, s_tiles),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, G, D), q.dtype),
+        interpret=interpret,
+    )(lens, qf, kf, vf)
+    return out.reshape(B, 1, n_heads, D)
+
+
+def decode_attention_jnp(
+    q: jax.Array,  # [B, 1, n_heads, D]
+    k: jax.Array,  # [B, S, n_kv, D]
+    v: jax.Array,
+    lengths: jax.Array,  # i32[B]
+    *,
+    tile_s: int = TILE_S,
+) -> jax.Array:
+    """The decode kernel's jnp twin: the SAME _fold_tile_math, iterated
+    over the B*n_kv grid axis with lax.map and over the S tiles with
+    lax.scan, with the same penalty construction. Sequential per-row
+    execution (not vmap) is deliberate: it keeps every dot_general the
+    exact per-instance shape the interpreted kernel runs, so XLA:CPU
+    picks the same lowering and kernel-vs-twin parity is exact
+    (np.array_equal), per the repo invariant — a vmapped batched dot
+    accumulates differently at G == 1. The twin runs every tile
+    densely; the kernel's skipped tiles contribute exactly 0 (p
+    underflows against a finite running max), so skipping never shows
+    up in the bits."""
+    B, T, n_heads, D = q.shape
+    if T != 1:
+        raise ValueError(f"decode_attention_jnp is T == 1 only; got T={T}")
+    S, n_kv = k.shape[1], k.shape[2]
+    G = n_heads // n_kv
+    tile_s = min(tile_s, S)
+    if S % tile_s:
+        raise ValueError(f"S={S} must divide by tile_s={tile_s}")
+    s_tiles = S // tile_s
+    BH = B * n_kv
+    scale = 1.0 / float(D) ** 0.5
+
+    qf = q.reshape(B, n_kv, G, D).reshape(BH, G, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(BH, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(BH, S, D)
+    row_len = jnp.repeat(jnp.asarray(lengths, jnp.int32), n_kv)  # [BH]
+
+    def _row(args):
+        qr, kr, vr, rl = args  # [G, D], [S, D], [S, D], i32
+
+        def step(carry, ts):
+            m, l, acc = carry
+            k_t = jax.lax.dynamic_slice_in_dim(kr, ts * tile_s, tile_s, 0)
+            v_t = jax.lax.dynamic_slice_in_dim(vr, ts * tile_s, tile_s, 0)
+            s_pos = ts * tile_s + jax.lax.broadcasted_iota(
+                jnp.int32, (1, tile_s), 1
+            )
+            pen = jnp.where(s_pos < rl, 0.0, -1e30)
+            return _fold_tile_math(
+                qr, k_t, v_t, pen, m, l, acc, groups=G, scale=scale
+            ), None
+
+        init = (
+            jnp.full((G, 1), -1e30, jnp.float32),
+            jnp.zeros((G, 1), jnp.float32),
+            jnp.zeros((G, D), jnp.float32),
+        )
+        (m, l, acc), _ = jax.lax.scan(
+            step, init, jnp.arange(s_tiles, dtype=jnp.int32)
+        )
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    out = jax.lax.map(_row, (qf, kf, vf, row_len))  # [BH, G, D]
+    return out.reshape(B, 1, n_heads, D)
+
+
+def decode_flash_available(S: int, D: int) -> bool:
+    """Shapes the decode kernel handles on the current default backend
+    — same conservative contract as flash_available (a wrong True is a
+    trace-time Mosaic error), minus the T constraints (T is always 1
+    here, folded into the G rows)."""
+    return (
+        jax.default_backend() == "tpu"
+        and S % min(TILE_S, S) == 0
+        and S % 128 == 0
+        and S >= 128
+        and D % 64 == 0
+    )
+
+
+def decode_attention_auto(q, k, v, lengths, mask):
+    """Decode-step attention router: the length-clamped Pallas kernel
+    when shapes/backend allow, dense jnp over ``mask`` otherwise. The
+    flash branch never reads ``mask`` — XLA dead-code-eliminates its
+    construction (the chunked_prefill contract). ``lengths`` and
+    ``mask`` must describe the same live set (mask[b] true exactly on
+    slots < lengths[b]) or the two branches diverge."""
+    if q.shape[1] == 1 and decode_flash_available(k.shape[1], q.shape[3]):
+        return decode_attention(q, k, v, lengths)
+    return dense_attention(q, k, v, mask)
 
 
 # --- backward (recompute-based custom_vjp over the ragged kernel) ----------

@@ -249,17 +249,27 @@ def decoder_layer(
     if kv_cache is not None:
         ck, cv = kv_cache
         if getattr(cache_offset, "ndim", 0) == 1:
-            # per-row offsets (continuous-batching slots at different
-            # sequence positions): vmapped row-wise update
-            def row_update(cache, new):
-                return jax.vmap(
-                    lambda c, n, o: jax.lax.dynamic_update_slice(
-                        c, n, (o, 0, 0)
-                    )
-                )(cache, new, cache_offset)
+            # per-row offsets (continuous-batching / ragged decode:
+            # rows at different sequence positions in one dispatch)
+            if T == 1:
+                # decode writes one token per row: a batched scatter
+                # lowers to a single fused scatter instead of the
+                # vmapped DUS's per-row gather/update chain — same
+                # values, so the vmap branch's exactness tests cover it
+                rows = jnp.arange(ck.shape[0])
+                ck = ck.at[rows, cache_offset].set(k[:, 0])
+                cv = cv.at[rows, cache_offset].set(v[:, 0])
+            else:
 
-            ck = row_update(ck, k)
-            cv = row_update(cv, v)
+                def row_update(cache, new):
+                    return jax.vmap(
+                        lambda c, n, o: jax.lax.dynamic_update_slice(
+                            c, n, (o, 0, 0)
+                        )
+                    )(cache, new, cache_offset)
+
+                ck = row_update(ck, k)
+                cv = row_update(cv, v)
         else:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))

@@ -243,26 +243,23 @@ class SPEngine:
             padded = np.pad(padded, ((0, 0), (0, T2 - T)))
             cache_len = max(cache_len, T2)
 
-        toks_out = np.zeros((B, max_new_tokens), np.int32)
-        lens_out = np.zeros((B,), np.int32)
-        # one decode batch per distinct prompt length (decode_scan's
-        # shared-cache-offset contract; same grouping as Engine.generate)
-        for L in sorted(set(lens.tolist())):
-            idx = np.nonzero(lens == L)[0]
-            toks, glens = self._gen(
-                self.params,
-                jnp.asarray(padded[idx]),
-                jnp.asarray(lens[idx]),
-                max_new_tokens,
-                cache_len,
-                jnp.int32(eos_id),
-                jnp.float32(temperature),
-                jnp.int32(top_k),
-                jnp.float32(top_p),
-                jnp.float32(repetition_penalty),
-                jax.random.fold_in(jax.random.PRNGKey(seed), L),
-            )
-            # lint: allow[host-sync] serving boundary: one readback per length bucket
-            toks_out[idx] = np.asarray(toks)
-            lens_out[idx] = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
+        # one dispatch for the whole (possibly length-ragged) batch:
+        # decode_scan carries per-row cache offsets, same as
+        # Engine.generate
+        toks, glens = self._gen(
+            self.params,
+            jnp.asarray(padded),
+            jnp.asarray(lens),
+            max_new_tokens,
+            cache_len,
+            jnp.int32(eos_id),
+            jnp.float32(temperature),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+            jnp.float32(repetition_penalty),
+            jax.random.PRNGKey(seed),
+        )
+        # lint: allow[host-sync] serving boundary: one readback per batch
+        toks_out = np.asarray(toks)
+        lens_out = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
         return GenerationResult(toks_out, lens_out)

@@ -368,6 +368,12 @@ class Manager:
                 server.abort()
                 return False
             prev_store = self.store
+            # a never-promoted replica has no _local_store attribute at
+            # all (only the self-hosting __init__ branch sets it); None
+            # is a safe restore value because stop() gates the close on
+            # store_server, which rollback also clears
+            prev_local = getattr(self, "_local_store", None)
+            prev_controller = self.controller
             started = False
             try:
                 self.store_server = server.start()
@@ -388,8 +394,15 @@ class Manager:
                     server.shutdown()
                 else:
                     server.abort()
+                # full rollback to follower state: every attribute the
+                # try block may have published must revert, or a later
+                # promotion attempt (and any reader meanwhile) sees a
+                # half-promoted manager pointed at the local store with
+                # a controller built against it
                 self.store_server = None
                 self.store = prev_store
+                self._local_store = prev_local
+                self.controller = prev_controller
                 self._is_leader.clear()
                 if self._lease is not None:
                     self._lease.stop()

@@ -112,6 +112,44 @@ class TestContinuousBatching:
             cont.submit(list(range(1, 60)), max_new_tokens=30)
 
 
+class TestMixedLengthSingleDispatch:
+    """The ragged-decode contract (r6 tentpole): Engine.generate solves
+    a length-ragged batch in ONE jit invocation — per-row cache offsets
+    replaced the per-length micro-batching — and every row stays
+    token-identical to its solo generation at temperature 0."""
+
+    def test_one_dispatch_token_exact(self, monkeypatch):
+        import kubeinfer_tpu.inference.engine as eng_mod
+
+        params = init_params(TINY, jax.random.PRNGKey(6))
+        ref = Engine(params, TINY, max_cache_len=64)
+        prompts = [
+            [1, 2, 3],
+            [7, 7, 7, 7, 7, 7, 7],
+            [42],
+            [9, 8, 7, 6, 5],
+        ]
+        solo = [ref_tokens(ref, p, 6) for p in prompts]
+
+        calls: list[tuple] = []
+        inner = eng_mod._generate_jit
+
+        def counting(params_, prompt, *args, **kw):
+            calls.append(tuple(prompt.shape))
+            return inner(params_, prompt, *args, **kw)
+
+        monkeypatch.setattr(eng_mod, "_generate_jit", counting)
+        out = Engine(params, TINY, max_cache_len=64).generate(
+            prompts, max_new_tokens=6
+        )
+        # 4 distinct prompt lengths, ONE dispatch carrying all rows in
+        # the shared 16-wide prompt bucket (the grouped engine made 4
+        # calls here)
+        assert calls == [(len(prompts), 16)], calls
+        for i, s in enumerate(solo):
+            assert out.tokens[i, : out.lengths[i]].tolist() == s, i
+
+
 class TestSpeculativeRouting:
     """The batcher's idle path routes through the draft; busy periods
     keep slot batching (VERDICT r2 item 3: speculative inside the

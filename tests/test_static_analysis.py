@@ -245,6 +245,30 @@ def test_single_arg_where_flagged():
     assert rules_of(fs) == ["jit-dynamic-shape"]
 
 
+def test_per_row_cache_scatter_clean():
+    # the ragged-decode cache write (model.decoder_layer): the batched
+    # .at[rows, offset].set scatter and the vmapped per-row
+    # dynamic_update_slice are both static-shape — traced values feed
+    # the INDICES, never the output shape
+    fs = run_src(
+        """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(cache, new, offset):
+            rows = jnp.arange(cache.shape[0])
+            ck = cache.at[rows, offset].set(new[:, 0])
+            cv = jax.vmap(
+                lambda c, n, o: jax.lax.dynamic_update_slice(
+                    c, n, (o, 0, 0)
+                )
+            )(cache, new, offset)
+            return ck, cv
+        """
+    )
+    assert fs == []
+
+
 # --- host-sync boundary rule ------------------------------------------------
 
 
