@@ -6,7 +6,7 @@ PY ?= python
 # whatever JAX backend is live (real TPU chip if present).
 
 .PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
-        bench-full lint run-manager run-agent docker-build clean
+        bench-full lint trace-demo run-manager run-agent docker-build clean
 
 all: native lint test-fast
 
@@ -47,6 +47,13 @@ bench-full: native
 lint:
 	$(PY) -m compileall -q kubeinfer_tpu tests scripts bench.py __graft_entry__.py
 	$(PY) -m kubeinfer_tpu.analysis kubeinfer_tpu tests scripts bench.py __graft_entry__.py
+
+# One traced serving request on the virtual CPU mesh; writes a
+# Perfetto-loadable Chrome trace JSON (docs/OBSERVABILITY.md walks the
+# span model). The module forces JAX_PLATFORMS=cpu itself; the env here
+# is belt-and-braces against this box's axon default.
+trace-demo:
+	JAX_PLATFORMS=cpu $(PY) -m kubeinfer_tpu.observability
 
 # local quickstart helpers (see README)
 run-manager:

@@ -524,6 +524,79 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
     }
 
 
+def serving_trace_bench(n_requests=16, prompt_len=64, max_new=8,
+                        n_slots=8, cache_len=256, model="bench-280m"):
+    """Serving-latency breakdown sourced from the TRACE layer.
+
+    Oversubscribes the continuous batcher (n_requests > n_slots) so
+    queue-wait is real, then reads TTFT and queue-wait from the
+    engine.queue_wait / engine.prefill spans the scheduler records —
+    the same spans /debug/spans exports — rather than from ad-hoc
+    timers. Publishing from the spans keeps the bench honest about what
+    the observability layer actually measures: if span timestamps
+    drift from reality, this number drifts with them and the
+    round-over-round history shows it.
+
+    TTFT here = queue_wait.start → prefill.end (submit to first
+    token), the serving definition; it includes scheduler queueing,
+    unlike the dispatch-level decode_ms_per_token keys.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.observability import tracing
+
+    cfg = PRESETS[model]
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    eng = ContinuousEngine(
+        params, cfg, n_slots=n_slots, cache_len=cache_len
+    ).start()
+    try:
+        # warm the prefill bucket + decode step so span timings measure
+        # steady-state serving, not jit compiles
+        warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        eng.generate(warm, max_new_tokens=max_new)
+        _touch_progress()
+        tracing.RECORDER.clear()
+        reqs = [
+            eng.submit(
+                rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
+                max_new_tokens=max_new,
+            )
+            for _ in range(n_requests)
+        ]
+        for r in reqs:
+            if not r.done.wait(timeout=300):
+                raise TimeoutError("traced request timed out")
+            _touch_progress()
+        spans = tracing.RECORDER.snapshot()
+    finally:
+        eng.stop()
+    queue_by_trace = {
+        s.trace_id: s for s in spans if s.name == "engine.queue_wait"
+    }
+    prefill_by_trace = {
+        s.trace_id: s for s in spans if s.name == "engine.prefill"
+    }
+    ttfts = [
+        prefill_by_trace[tid].end - q.start
+        for tid, q in queue_by_trace.items()
+        if tid in prefill_by_trace
+    ]
+    waits = [s.duration() for s in queue_by_trace.values()]
+    if not ttfts or not waits:
+        raise RuntimeError("trace layer recorded no serving spans")
+    return {
+        "ttft_ms_b8": round(statistics.median(ttfts) * 1e3, 3),
+        "queue_wait_ms_p99": round(
+            float(np.percentile(np.asarray(waits), 99)) * 1e3, 3
+        ),
+    }
+
+
 _last_progress = [0.0]
 
 
@@ -896,6 +969,16 @@ def main() -> None:
                 extras[f"native_engine_{key}_1p7b"] = big[key]
         except Exception as e:
             extras["native_engine_1p7b_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # trace-sourced serving breakdown (observability PR): TTFT and
+        # queue-wait p99 read from the engine's own spans, with the
+        # batcher deliberately oversubscribed so queue-wait is nonzero
+        try:
+            tr = serving_trace_bench(n_slots=8)
+            extras["ttft_ms_b8"] = tr["ttft_ms_b8"]
+            extras["queue_wait_ms_p99"] = tr["queue_wait_ms_p99"]
+        except Exception as e:
+            extras["serving_trace_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
 
     print(

@@ -38,8 +38,11 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
 )
 from kubeinfer_tpu.coordination.lease import LeaseManager
+from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
+
+_TRACER = tracing.get_tracer("node-agent")
 
 log = logging.getLogger(__name__)
 
@@ -448,6 +451,10 @@ class NodeAgent:
         stays reported as free, preserving the anti-oscillation rule
         above), and the advertised free memory shrinks by exactly that.
         """
+        with _TRACER.span("agent.heartbeat", node=self.node_name):
+            self._heartbeat()
+
+    def _heartbeat(self) -> None:
         faultpoints.fire("agent.heartbeat", key=self.node_name)
         mem_free = self._mem_capacity
         if self._observe_memory is not None:
@@ -557,6 +564,13 @@ class NodeAgent:
         way. Recovery is automatic: the first successful list refreshes
         the cache and zeroes the staleness gauge.
         """
+        # span per tick: store-client attempt spans and retry/fault
+        # events from the resilience layer nest under it, so a chaos
+        # run's degraded ticks are explainable from the trace alone
+        with _TRACER.span("agent.tick", node=self.node_name) as sp:
+            self._tick(sp)
+
+    def _tick(self, sp: "tracing.Span") -> None:
         degraded = False
         try:
             workloads = [
@@ -576,6 +590,7 @@ class NodeAgent:
         except STORE_TRANSIENT:
             degraded = True
         if degraded:
+            sp.event("degraded")
             metrics.agent_degraded_ticks_total.inc(self.node_name)
         if degraded and self._stale_since is None:
             self._stale_since = self._clock.now()

@@ -29,7 +29,11 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
+from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.resilience import RetryPolicy, faultpoints
+from kubeinfer_tpu.utils.httpbase import inject_traceparent
+
+_TRACER = tracing.get_tracer("transfer")
 
 # Written into the model dir after a FULLY verified sync; its presence is
 # the only thing that distinguishes "complete local copy" from "partial
@@ -110,7 +114,9 @@ def fetch_file_list(endpoint: str, ca_file: str = "") -> list[FileEntry]:
     faultpoints.fire("transfer.fetch", key="/models")
     conn, base = _open(endpoint, ca_file)
     try:
-        conn.request("GET", base + "/models")
+        conn.request(
+            "GET", base + "/models", headers=inject_traceparent({})
+        )
         resp = conn.getresponse()
         if resp.status != 200:
             raise TransferError(f"/models returned {resp.status}")
@@ -139,6 +145,7 @@ def download_file(
     expected_total = -1
     try:
         headers = {"Range": f"bytes={offset}-"} if offset else {}
+        inject_traceparent(headers)
         conn.request("GET", base + "/models/" + urllib.parse.quote(rel_path), headers=headers)
         resp = conn.getresponse()
         if resp.status == 200:
@@ -267,10 +274,15 @@ def sync_model(
         # attempt budget, not wall time, bounds it
         classify=lambda e: isinstance(e, _SYNC_TRANSIENT),
     )
-    try:
-        return policy.call(attempt_once, edge="transfer.sync", sleep=sleep)
-    except _SYNC_TRANSIENT as e:
-        raise TransferError(
-            f"sync from {last_ep[0] or endpoint} failed after "
-            f"{attempts} attempts: {e}"
-        ) from e
+    # the span wraps the whole retry schedule, so per-attempt retry
+    # events and fault-point activations land on it
+    with _TRACER.span("transfer.sync", dest=dest_dir):
+        try:
+            return policy.call(
+                attempt_once, edge="transfer.sync", sleep=sleep
+            )
+        except _SYNC_TRANSIENT as e:
+            raise TransferError(
+                f"sync from {last_ep[0] or endpoint} failed after "
+                f"{attempts} attempts: {e}"
+            ) from e

@@ -53,6 +53,11 @@ RULES = {
     "lock-discipline": (
         "attribute written both under its class lock and outside any lock"
     ),
+    "log-discipline": (
+        "bare print() or logging.basicConfig() in a library module "
+        "(CLI entrypoints — __main__.py, ctl.py, bench.py, scripts/ — "
+        "are exempt)"
+    ),
     "lint-bare-allow": (
         "a `# lint: allow[rule]` without a reason string (reasons are "
         "mandatory; this finding is itself unsuppressable)"
@@ -183,7 +188,7 @@ def analyze_source(
     """
     # local imports: core is imported by racecheck users at runtime and
     # must not pay for the AST passes unless analysis actually runs
-    from kubeinfer_tpu.analysis import jitlint, lockcheck
+    from kubeinfer_tpu.analysis import jitlint, lockcheck, logdiscipline
 
     if boundary is None:
         boundary = not _is_test_file(path)
@@ -202,6 +207,7 @@ def analyze_source(
     findings.extend(jitlint.run(tree, path, call_registry,
                                 def_registry=local, boundary=boundary))
     findings.extend(lockcheck.run(tree, path))
+    findings.extend(logdiscipline.run(tree, path))
     sup = _collect_suppressions(source, path)
     findings = [f for f in findings if not sup.allows(f)]
     findings.extend(sup.meta_findings)

@@ -40,11 +40,14 @@ from kubeinfer_tpu.controlplane.store import (
     NotFoundError,
     Store,
 )
+from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.scheduler import SolveRequest, get_backend
 from kubeinfer_tpu.solver.problem import GIB, MAX_MODELS
 from kubeinfer_tpu.utils.clock import Clock, RealClock
 
 log = logging.getLogger(__name__)
+
+_TRACER = tracing.get_tracer("controller")
 
 CONTROLLER_NAME = "llmservice"  # reconcile_total{controller=...}
 NODE_HEARTBEAT_TTL_S = 30.0  # nodes silent longer than this are unschedulable
@@ -292,7 +295,9 @@ class Controller:
                 node_topology=n_topo,
                 node_cached=cached,
             )
-            res = get_backend(policy).solve(req)
+            with _TRACER.span("controller.solve", policy=policy,
+                              jobs=len(rows), nodes=len(nodes)):
+                res = get_backend(policy).solve(req)
             result.solve_ms[policy] = res.solve_ms
             result.replicas_total += len(rows)
             result.replicas_placed += res.placed
@@ -364,6 +369,15 @@ class Controller:
     # -- the tick ----------------------------------------------------------
 
     def reconcile_once(self) -> ReconcileResult:
+        # one span per tick: store-client spans (lists, status writes)
+        # and per-policy solve spans nest under it
+        with _TRACER.span("controller.reconcile") as sp:
+            result = self._reconcile_once()
+            sp.set(services=result.services, nodes=result.nodes,
+                   placed=result.replicas_placed)
+            return result
+
+    def _reconcile_once(self) -> ReconcileResult:
         t0 = time.perf_counter()
         result = ReconcileResult()
         now = self._clock.now()

@@ -59,14 +59,19 @@ from kubeinfer_tpu.resilience import (
 )
 from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.analysis.racecheck import make_condition
+from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.utils.httpbase import (
     BaseEndpointHandler,
     client_ssl_context,
     token_matches,
+    traceparent_header,
     wrap_server_tls,
 )
 
 log = logging.getLogger(__name__)
+
+_SERVER_TRACER = tracing.get_tracer("store")
+_CLIENT_TRACER = tracing.get_tracer("store-client")
 
 EVENT_LOG_SIZE = 65536  # ring of recent events served to long-pollers
 
@@ -139,6 +144,14 @@ class StoreServer:
                 if not self._authed():
                     self._drop_body()
                     self._send(401, {"error": "unauthorized"})
+                    return
+                if parts == ["debug", "spans"] and method == "GET":
+                    # recorded spans as Chrome trace-event JSON (open in
+                    # Perfetto; docs/OBSERVABILITY.md). Authenticated:
+                    # traces carry request paths and object names.
+                    self._drop_body()
+                    tid = q.get("trace_id", [None])[0]
+                    self._send(200, tracing.RECORDER.to_chrome_trace(tid))
                     return
                 try:
                     if parts == ["rv"] and method == "GET":
@@ -227,17 +240,27 @@ class StoreServer:
                     log.exception("httpstore: internal error")
                     self._send(500, {"error": "internal", "message": str(e)})
 
+            def _traced(self, method: str) -> None:
+                # server-side span per request, joined to the caller's
+                # trace via the inbound traceparent header (path as an
+                # attr, not the span name — names stay low-cardinality)
+                with _SERVER_TRACER.span(
+                    f"store {method}", parent=self.trace_context(),
+                    path=self.path,
+                ):
+                    self._route(method)
+
             def do_GET(self):
-                self._route("GET")
+                self._traced("GET")
 
             def do_POST(self):
-                self._route("POST")
+                self._traced("POST")
 
             def do_PUT(self):
-                self._route("PUT")
+                self._traced("PUT")
 
             def do_DELETE(self):
-                self._route("DELETE")
+                self._traced("DELETE")
 
         self._httpd = wrap_server_tls(
             ThreadingHTTPServer((host, port), Handler), tls_cert, tls_key
@@ -439,35 +462,45 @@ class RemoteStore:
         req.add_header("Content-Type", "application/json")
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
-        faultpoints.fire("store.request", key=f"{method} {path}")
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout or self._timeout,
-                context=self._ssl_ctx,
-            ) as resp:
-                raw = faultpoints.mangle(
-                    "store.request", resp.read(), key=f"{method} {path}"
-                )
-                return json.loads(raw or b"null")
-        except urllib.error.HTTPError as e:
-            payload = {}
+        # one client span per ATTEMPT (this method is the retry unit):
+        # retries show as sibling spans under the caller, and the
+        # retry-policy events land on the enclosing caller span because
+        # each attempt span has already ended when the policy fires them
+        with _CLIENT_TRACER.span(
+            f"store.{method}", path=path.split("?", 1)[0]
+        ):
+            tp = traceparent_header()
+            if tp:
+                req.add_header("traceparent", tp)
+            faultpoints.fire("store.request", key=f"{method} {path}")
             try:
-                payload = json.loads(e.read() or b"{}")
-            except json.JSONDecodeError:
-                pass
-            msg = payload.get("message", str(e))
-            code = payload.get("error", "")
-            if e.code == 404:
-                raise NotFoundError(msg) from None
-            if e.code == 409 and code == "already_exists":
-                raise AlreadyExistsError(msg) from None
-            if e.code == 409:
-                raise ConflictError(msg) from None
-            if e.code == 400:
-                raise ValidationError(msg) from None
-            if e.code == 401:
-                raise PermissionError(f"unauthorized: {url}") from None
-            raise
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self._timeout,
+                    context=self._ssl_ctx,
+                ) as resp:
+                    raw = faultpoints.mangle(
+                        "store.request", resp.read(), key=f"{method} {path}"
+                    )
+                    return json.loads(raw or b"null")
+            except urllib.error.HTTPError as e:
+                payload = {}
+                try:
+                    payload = json.loads(e.read() or b"{}")
+                except json.JSONDecodeError:
+                    pass
+                msg = payload.get("message", str(e))
+                code = payload.get("error", "")
+                if e.code == 404:
+                    raise NotFoundError(msg) from None
+                if e.code == 409 and code == "already_exists":
+                    raise AlreadyExistsError(msg) from None
+                if e.code == 409:
+                    raise ConflictError(msg) from None
+                if e.code == 400:
+                    raise ValidationError(msg) from None
+                if e.code == 401:
+                    raise PermissionError(f"unauthorized: {url}") from None
+                raise
 
     def healthz(self) -> bool:
         try:

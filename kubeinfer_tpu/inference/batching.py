@@ -37,6 +37,15 @@ from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.observability import tracing
+
+# spans are recorded retroactively from the request timeline below, so
+# the scheduler never holds a live span across passes (docs/OBSERVABILITY.md)
+_TRACER = tracing.get_tracer("engine")
+
+# per-token instant events on the decode span are capped so a single
+# long completion cannot dominate the span ring's memory
+_MAX_TOKEN_EVENTS = 128
 
 # --- device state ----------------------------------------------------------
 
@@ -284,6 +293,18 @@ class _Request:
     # set instead of a normal completion when the engine shut down
     # mid-flight — truncated output must not look like success
     failed: str = ""
+    # request timeline, tracing-clock seconds: the scheduler writes
+    # these at the admit/first-token/retire transitions and the server
+    # reads them AFTER done is set (the Event is the happens-before
+    # edge), deriving the queue-wait/TTFT/TPOT histograms without a
+    # second timing source. trace_parent anchors the retroactive
+    # engine spans to the caller's trace (or a fresh one).
+    trace_parent: "tracing.SpanContext | None" = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
+    token_times: list[float] = field(default_factory=list)
 
     def cancel(self) -> None:
         """Abandon the request: the scheduler drops it before admission
@@ -377,14 +398,24 @@ class ContinuousEngine:
         req = _Request(prompt, max_new_tokens, eos_id,
                        temperature=temperature, top_k=top_k, top_p=top_p,
                        rep_penalty=repetition_penalty, seed=seed)
+        # capture the submitter's trace context here (scheduler runs on
+        # its own thread, where the thread-local stack is empty); no
+        # inbound context still gets a per-request trace anchor
+        ctx = tracing.current_context()
+        req.trace_parent = ctx if ctx is not None else \
+            tracing.new_root_context()
+        req.t_submit = tracing.now()
         self._queue.put(req)
         return req
 
-    def generate(self, prompt: list[int], max_new_tokens: int = 32,
-                 eos_id: int = -1, temperature: float = 0.0,
-                 seed: int = 0, top_k: int = 0, top_p: float = 1.0,
-                 repetition_penalty: float = 1.0,
-                 timeout: float = 300.0) -> list[int]:
+    def serve(self, prompt: list[int], max_new_tokens: int = 32,
+              eos_id: int = -1, temperature: float = 0.0,
+              seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+              repetition_penalty: float = 1.0,
+              timeout: float = 300.0) -> _Request:
+        """submit() + wait, returning the completed request object so
+        callers (the HTTP server's latency-breakdown histograms) can
+        read the timeline fields alongside the tokens."""
         req = self.submit(prompt, max_new_tokens, eos_id,
                           temperature=temperature, seed=seed,
                           top_k=top_k, top_p=top_p,
@@ -394,7 +425,18 @@ class ContinuousEngine:
             raise TimeoutError("generation timed out")
         if req.failed:
             raise RuntimeError(req.failed)
-        return req.out_tokens
+        return req
+
+    def generate(self, prompt: list[int], max_new_tokens: int = 32,
+                 eos_id: int = -1, temperature: float = 0.0,
+                 seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                 repetition_penalty: float = 1.0,
+                 timeout: float = 300.0) -> list[int]:
+        return self.serve(
+            prompt, max_new_tokens, eos_id, temperature=temperature,
+            seed=seed, top_k=top_k, top_p=top_p,
+            repetition_penalty=repetition_penalty, timeout=timeout,
+        ).out_tokens
 
     def prewarm_spec(self, group_sizes: tuple[int, ...] = (1,),
                      prompt_len: int = 8, max_new_tokens: int = 8,
@@ -479,6 +521,11 @@ class ContinuousEngine:
     # -- scheduler loop ---------------------------------------------------
 
     def _admit(self, slot: int, req: _Request) -> None:
+        req.t_admit = tracing.now()
+        _TRACER.record_span(
+            "engine.queue_wait", start=req.t_submit, end=req.t_admit,
+            parent=req.trace_parent, slot=slot,
+        )
         T = _bucket(len(req.prompt))  # submit() guarantees T <= cache_len
         padded = np.zeros((1, T), np.int32)
         padded[0, : len(req.prompt)] = req.prompt
@@ -499,6 +546,14 @@ class ContinuousEngine:
         # lint: allow[host-sync] admission boundary: the first token must reach the request result now
         first = int(self._state.last_token[slot])
         req.out_tokens.append(first)
+        req.t_first = tracing.now()
+        req.token_times.append(req.t_first)
+        sp = _TRACER.start_span(
+            "engine.prefill", parent=req.trace_parent, start=req.t_admit,
+            slot=slot, prompt_tokens=len(req.prompt), bucket=T,
+        )
+        sp.event("first-token", ts=req.t_first)
+        _TRACER.finish(sp, end=req.t_first)
         self._maybe_retire(slot)
 
     def _maybe_retire(self, slot: int) -> None:
@@ -519,6 +574,16 @@ class ContinuousEngine:
                 self._state,
                 active=self._state.active.at[slot].set(False),
             )
+            req.t_done = tracing.now()
+            sp = _TRACER.start_span(
+                "engine.decode", parent=req.trace_parent,
+                start=req.t_first or req.t_done, slot=slot,
+                tokens=len(req.out_tokens),
+                cancelled=req.cancelled.is_set(),
+            )
+            for i, ts in enumerate(req.token_times[:_MAX_TOKEN_EVENTS]):
+                sp.event("token", ts=ts, i=i)
+            _TRACER.finish(sp, end=req.t_done)
             req.done.set()
 
     def _drain_spec_group(
@@ -644,10 +709,19 @@ class ContinuousEngine:
         # per-group carry, NOT engine.last_stats: the bulk speculative
         # route mutates that shared field from HTTP threads concurrently
         self.spec_accepted += g.accepted_drafts
+        fin = tracing.now()
         for b, r in enumerate(reqs):
             n = min(int(out.lengths[b]), r.max_new)
             r.out_tokens.extend(out.tokens[b, :n].tolist())
             self.spec_served += 1
+            r.t_done = fin
+            # draft groups have no slot timeline; one span covers the
+            # whole group residency so spec traffic still shows up in
+            # the trace (attrs mark it for the breakdown readers)
+            _TRACER.record_span(
+                "engine.spec_group", start=r.t_submit, end=fin,
+                parent=r.trace_parent, tokens=n, group_size=len(reqs),
+            )
             r.done.set()
 
     def _place(self, req: "_Request") -> bool:
@@ -726,11 +800,15 @@ class ContinuousEngine:
                 )
                 # lint: allow[host-sync] per-step decode boundary: tokens feed the Python result queues
                 toks = np.asarray(tokens)
+                # one clock read per device step, outside the lock: all
+                # tokens of a step share its arrival time
+                step_t = tracing.now()
                 with self._lock:
                     for slot in range(self.n_slots):
                         req = self._slot_req[slot]
                         if req is not None and toks[slot] >= 0:
                             req.out_tokens.append(int(toks[slot]))
+                            req.token_times.append(step_t)
                             self._maybe_retire(slot)
             self._step_spec_group()  # locked no-op when no group is live
         # epilogue: anything published after stop()'s sweep (admission

@@ -35,6 +35,9 @@ from kubeinfer_tpu.inference.flash_attention import (
     flash_available,
 )
 from kubeinfer_tpu.inference.model import Params, forward
+from kubeinfer_tpu.observability import tracing
+
+_TRACER = tracing.get_tracer("engine")
 
 PROMPT_BUCKETS = (
     16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768,
@@ -521,25 +524,27 @@ class Engine:
                 np.zeros((0, 0), np.int32), np.zeros((0,), np.int32)
             )
         B = len(prompts)
-        padded, lens, cache_len = prepare_prompts(
-            prompts, max_new_tokens, self.max_cache_len
-        )
-        toks, glens = _generate_jit(
-            self.params,
-            jnp.asarray(padded),
-            jnp.asarray(lens),
-            self.cfg,
-            max_new_tokens,
-            cache_len,
-            prefill_chunk_for(B, int(padded.shape[1])),
-            jnp.int32(eos_id),
-            jnp.float32(temperature),
-            jnp.int32(top_k),
-            jnp.float32(top_p),
-            jnp.float32(repetition_penalty),
-            jax.random.PRNGKey(seed),
-        )
-        # lint: allow[host-sync] serving boundary: one readback per batch
-        toks_out = np.asarray(toks)
-        lens_out = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
+        with _TRACER.span("engine.generate", batch=B,
+                          max_new=max_new_tokens):
+            padded, lens, cache_len = prepare_prompts(
+                prompts, max_new_tokens, self.max_cache_len
+            )
+            toks, glens = _generate_jit(
+                self.params,
+                jnp.asarray(padded),
+                jnp.asarray(lens),
+                self.cfg,
+                max_new_tokens,
+                cache_len,
+                prefill_chunk_for(B, int(padded.shape[1])),
+                jnp.int32(eos_id),
+                jnp.float32(temperature),
+                jnp.int32(top_k),
+                jnp.float32(top_p),
+                jnp.float32(repetition_penalty),
+                jax.random.PRNGKey(seed),
+            )
+            # lint: allow[host-sync] serving boundary: one readback per batch
+            toks_out = np.asarray(toks)
+            lens_out = np.asarray(glens)  # lint: allow[host-sync] same readback as the line above
         return GenerationResult(toks_out, lens_out)

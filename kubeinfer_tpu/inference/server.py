@@ -42,13 +42,17 @@ import sys
 import threading
 import time
 from http.server import ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from kubeinfer_tpu.metrics.registry import (
     Counter, Gauge, Histogram, Registry,
 )
+from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler
 
 log = logging.getLogger(__name__)
+
+_TRACER = tracing.get_tracer("inference-server")
 
 
 def _serving_metrics(registry: Registry):
@@ -74,6 +78,34 @@ def _serving_metrics(registry: Registry):
             "End-to-end completion latency",
             buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                      60.0, 120.0),
+            labels=("route",), registry=registry,
+        ),
+        # per-request latency breakdown (the vLLM request-metrics plane
+        # equivalents): TTFT/queue-wait come from the batcher's own
+        # request timeline when the continuous route served the request
+        # (t_submit/t_admit/t_first, batching.py _Request); routes with
+        # no internal timeline degrade to end-to-end figures — same
+        # family, split by the route label
+        "ttft": Histogram(
+            "kubeinfer_inference_ttft_seconds",
+            "Time to first generated token (queue wait + admission + "
+            "prefill on the continuous route; end-to-end elsewhere)",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0),
+            labels=("route",), registry=registry,
+        ),
+        "tpot": Histogram(
+            "kubeinfer_inference_time_per_output_token_seconds",
+            "Mean decode time per generated token after the first",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0),
+            labels=("route",), registry=registry,
+        ),
+        "queue_wait": Histogram(
+            "kubeinfer_inference_queue_wait_seconds",
+            "Submit-to-admission wait in the continuous batcher",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     30.0),
             labels=("route",), registry=registry,
         ),
         # speculation effectiveness (r4 verdict weak #3 follow-through:
@@ -131,6 +163,17 @@ class InferenceServer:
                             "owned_by": "kubeinfer-tpu",
                         }],
                     }))
+                elif path == "/debug/spans":
+                    # recorded spans as Chrome trace-event JSON —
+                    # save the body and open it in Perfetto
+                    # (docs/OBSERVABILITY.md); ?trace_id= narrows to
+                    # one request's trace
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    self.respond(
+                        200, "application/json",
+                        json.dumps(tracing.RECORDER.to_chrome_trace(tid)),
+                    )
                 else:
                     self.respond(404, "text/plain", "not found\n")
 
@@ -141,26 +184,36 @@ class InferenceServer:
                 if path != "/v1/completions":
                     self.respond(404, "text/plain", "not found\n")
                     return
-                try:
+                # server-side span joins the caller's trace when a
+                # traceparent header arrived; otherwise this request
+                # starts a fresh trace
+                with _TRACER.span(
+                    "http POST /v1/completions",
+                    parent=self.trace_context(),
+                ) as sp:
                     try:
-                        body = json.loads(raw or b"{}")
-                    except ValueError:
-                        # malformed JSON never reaches complete(); count
-                        # it here or a flood of garbage 400s shows zero
-                        # in requests_total
-                        server.metrics["requests"].inc("invalid", "invalid")
-                        raise
-                    resp = server.complete(body)
-                    self.respond(200, "application/json", json.dumps(resp))
-                except ValueError as e:
-                    self.respond(400, "application/json", json.dumps(
-                        {"error": {"message": str(e), "type": "invalid_request_error"}}
-                    ))
-                except Exception as e:  # keep the serving thread alive
-                    log.exception("completion failed")
-                    self.respond(500, "application/json", json.dumps(
-                        {"error": {"message": str(e), "type": "server_error"}}
-                    ))
+                        try:
+                            body = json.loads(raw or b"{}")
+                        except ValueError:
+                            # malformed JSON never reaches complete(); count
+                            # it here or a flood of garbage 400s shows zero
+                            # in requests_total
+                            server.metrics["requests"].inc("invalid", "invalid")
+                            raise
+                        resp = server.complete(body)
+                        sp.set(status=200)
+                        self.respond(200, "application/json", json.dumps(resp))
+                    except ValueError as e:
+                        sp.set(status=400)
+                        self.respond(400, "application/json", json.dumps(
+                            {"error": {"message": str(e), "type": "invalid_request_error"}}
+                        ))
+                    except Exception as e:  # keep the serving thread alive
+                        log.exception("completion failed")
+                        sp.set(status=500)
+                        self.respond(500, "application/json", json.dumps(
+                            {"error": {"message": str(e), "type": "server_error"}}
+                        ))
 
         from kubeinfer_tpu.utils.httpbase import wrap_server_tls
 
@@ -212,24 +265,60 @@ class InferenceServer:
         # matters)
         route_box = {"route": "invalid"}
         t0 = time.perf_counter()
-        try:
-            resp = self._complete(body, route_box)
-        except ValueError:
-            self.metrics["requests"].inc(route_box["route"], "invalid")
-            raise
-        except Exception:
-            self.metrics["requests"].inc(route_box["route"], "error")
-            raise
+        with _TRACER.span("server.complete") as span:
+            try:
+                resp = self._complete(body, route_box)
+            except ValueError:
+                self.metrics["requests"].inc(route_box["route"], "invalid")
+                raise
+            except Exception:
+                self.metrics["requests"].inc(route_box["route"], "error")
+                raise
+            finally:
+                span.set(route=route_box["route"])
         route = route_box["route"]
+        dur = time.perf_counter() - t0
         self.metrics["requests"].inc(route, "ok")
-        self.metrics["latency"].observe(route, time.perf_counter() - t0)
+        self.metrics["latency"].observe(route, dur)
         self.metrics["prompt_tokens"].inc(
             by=resp["usage"]["prompt_tokens"]
         )
         self.metrics["completion_tokens"].inc(
             by=resp["usage"]["completion_tokens"]
         )
+        self._observe_breakdown(
+            route, dur, resp["usage"]["completion_tokens"],
+            route_box.get("timing"),
+        )
         return resp
+
+    def _observe_breakdown(self, route: str, total_s: float, n_out: int,
+                           req=None) -> None:
+        """Derived latency-breakdown histograms. The continuous route
+        hands back its ``_Request`` (``timing`` in the route box) whose
+        t_submit/t_admit/t_first/t_done were stamped by the scheduler
+        itself; routes without an internal timeline degrade to
+        end-to-end TTFT and mean-per-token TPOT — the route label keeps
+        the populations separable on dashboards."""
+        ttft = total_s
+        decode_s = None
+        if req is not None and req.t_submit:
+            if req.t_admit:
+                self.metrics["queue_wait"].observe(
+                    route, max(0.0, req.t_admit - req.t_submit)
+                )
+            end = req.t_done or req.t_submit + total_s
+            if req.t_first:
+                ttft = max(0.0, req.t_first - req.t_submit)
+                decode_s = max(0.0, end - req.t_first)
+            else:  # draft-group path: no per-token timeline
+                ttft = max(0.0, end - req.t_submit)
+        self.metrics["ttft"].observe(route, ttft)
+        if decode_s is not None and n_out > 1:
+            tpot = decode_s / (n_out - 1)
+        else:
+            tpot = total_s / max(1, n_out)
+        self.metrics["tpot"].observe(route, tpot)
 
     def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")
@@ -313,12 +402,16 @@ class InferenceServer:
             # through to the per-request engine, which serves the model's
             # full context.
             route_box["route"] = "continuous"
-            gen = self.continuous.generate(
+            req = self.continuous.serve(
                 ids, max_new_tokens=max_tokens, eos_id=eos_id,
                 temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p,
                 repetition_penalty=rep_penalty,
             )
+            gen = req.out_tokens
+            # hand the scheduler-stamped timeline to complete() for the
+            # TTFT/TPOT/queue-wait histograms
+            route_box["timing"] = req
         else:
             route_box["route"] = "engine"
             out = self.engine.generate(
@@ -426,6 +519,7 @@ def main(argv: list[str] | None = None) -> int:
                         "--tls-key-file)")
     p.add_argument("--tls-key-file", default="")
     args = p.parse_args(argv)
+    # lint: allow[log-discipline] main() is the process entrypoint and owns root logging config
     logging.basicConfig(level=logging.INFO)
 
     import jax

@@ -23,6 +23,15 @@ class Registry:
 
     def register(self, c: "_Collector") -> None:
         with self._lock:
+            # Prometheus servers reject duplicate metric families; catching
+            # the collision at registration time (instead of at scrape time,
+            # or never — the old behavior silently rendered both) turns a
+            # copy-paste collector name into an immediate, attributable error.
+            for existing in self._collectors:
+                if existing.name == c.name:
+                    raise ValueError(
+                        f"collector {c.name!r} already registered"
+                    )
             self._collectors.append(c)
 
     def render(self) -> str:

@@ -1,0 +1,38 @@
+"""Request-scoped observability: tracing, trace-context propagation,
+Chrome trace export. See docs/OBSERVABILITY.md for the span model."""
+
+from kubeinfer_tpu.observability.tracing import (
+    RECORDER,
+    Span,
+    SpanContext,
+    SpanRecorder,
+    TraceContextFilter,
+    Tracer,
+    add_event,
+    current_context,
+    current_span,
+    get_tracer,
+    new_root_context,
+    now,
+    parse_traceparent,
+    set_clock,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "RECORDER",
+    "Span",
+    "SpanContext",
+    "SpanRecorder",
+    "TraceContextFilter",
+    "Tracer",
+    "add_event",
+    "current_context",
+    "current_span",
+    "get_tracer",
+    "new_root_context",
+    "now",
+    "parse_traceparent",
+    "set_clock",
+    "to_chrome_trace",
+]

@@ -52,6 +52,7 @@ from kubeinfer_tpu.metrics.registry import (
     retries_exhausted_total,
     retry_attempts_total,
 )
+from kubeinfer_tpu.observability import tracing
 
 __all__ = [
     "BreakerOpenError",
@@ -196,9 +197,20 @@ class RetryPolicy:
                 if out_of_budget:
                     if edge:
                         retries_exhausted_total.inc(edge)
+                    # span events mirror the counters so a chaos run's
+                    # trace explains WHICH request burned its budget
+                    # (no-ops outside an active span)
+                    tracing.add_event(
+                        "retries-exhausted", edge=edge, attempts=attempt,
+                        error=type(exc).__name__,
+                    )
                     raise
                 if edge:
                     retry_attempts_total.inc(edge)
+                tracing.add_event(
+                    "retry", edge=edge, attempt=attempt,
+                    error=type(exc).__name__, delay_s=round(delay, 4),
+                )
                 sleep(delay)
             else:
                 if breaker is not None:

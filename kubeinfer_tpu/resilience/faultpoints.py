@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 
 from kubeinfer_tpu.metrics.registry import fault_injections_total
 from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.observability import tracing
 
 __all__ = ["FaultSpec", "FaultRegistry", "REGISTRY", "fire", "mangle"]
 
@@ -176,6 +177,11 @@ class FaultRegistry:
             s = self._select(point, key, ("error", "latency", "blackhole"))
         if s is None:
             return
+        # activation event on whatever span is live at the edge (the
+        # store client span, a heartbeat span, ...) — chaos-run traces
+        # show WHERE each injection landed; outside the lock like the
+        # sleeps below
+        tracing.add_event("fault", point=point, mode=s.mode, key=key)
         # sleep OUTSIDE the lock: concurrent edges must not serialize on
         # an injected latency
         if s.mode == "latency":
@@ -201,7 +207,8 @@ class FaultRegistry:
             cut = self._rng.randrange(len(data)) if len(data) > 1 else 1
             out = bytearray(data[:max(1, cut)])
             out[-1] ^= 0xFF
-            return bytes(out)
+        tracing.add_event("fault", point=point, mode="corrupt", key=key)
+        return bytes(out)
 
 
 REGISTRY = FaultRegistry()

@@ -13,7 +13,27 @@ import logging
 import ssl
 from http.server import BaseHTTPRequestHandler
 
+from kubeinfer_tpu.observability import tracing
+
 log = logging.getLogger(__name__)
+
+
+def traceparent_header() -> str | None:
+    """W3C ``traceparent`` value for the calling thread's active span,
+    or None outside any span. Single injection point for every HTTP
+    client in the package (store client, model transfer) so the header
+    format lives in one place."""
+    ctx = tracing.current_context()
+    return ctx.traceparent() if ctx is not None else None
+
+
+def inject_traceparent(headers: dict) -> dict:
+    """Add the current ``traceparent`` (if any) to a mutable header
+    dict; returns it for call-site chaining."""
+    tp = traceparent_header()
+    if tp is not None:
+        headers["traceparent"] = tp
+    return headers
 
 
 def wrap_server_tls(httpd, tls_cert: str, tls_key: str = ""):
@@ -77,6 +97,12 @@ class BaseEndpointHandler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # route to logging, not stderr
         log.debug("http: " + fmt, *args)
+
+    def trace_context(self) -> "tracing.SpanContext | None":
+        """Extract the inbound W3C trace context, if the client sent
+        one; the single extraction point mirroring
+        :func:`traceparent_header` on the client side."""
+        return tracing.parse_traceparent(self.headers.get("traceparent"))
 
     def respond(self, code: int, ctype: str, payload: bytes | str) -> None:
         data = payload.encode() if isinstance(payload, str) else payload

@@ -80,6 +80,8 @@ _COMPONENT_BY_PREFIX = (
     (("test_chaos", "test_resilience"), "chaos"),
     # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/)
     (("test_static_analysis",), "analysis"),
+    # tracing + serving latency breakdown (kubeinfer_tpu/observability/)
+    (("test_observability",), "observability"),
 )
 
 

@@ -70,3 +70,118 @@ class TestRegistry:
         assert "x_total 1" in text
         r.reset()
         assert "x_total 1" not in r.render()
+
+    def test_duplicate_name_rejected(self):
+        r = Registry()
+        Counter("dup_total", "first", registry=r)
+        with pytest.raises(ValueError, match="dup_total"):
+            Counter("dup_total", "second", registry=r)
+        # the rejected collector must not have been half-registered
+        assert r.render().count("# TYPE dup_total") == 1
+
+    def test_duplicate_across_types_rejected(self):
+        r = Registry()
+        Counter("dup2", "as counter", registry=r)
+        with pytest.raises(ValueError):
+            Gauge("dup2", "as gauge", registry=r)
+
+
+# --- text exposition, checked by parsing (not substring matching) ----------
+#
+# A tiny exposition parser: enough of the Prometheus text format to
+# round-trip what Registry.render() emits. Char-by-char label parsing so
+# escaped quotes/backslashes inside label VALUES are exercised for real —
+# a substring assertion would pass even if escaping were broken.
+
+
+def _parse_labels(s: str) -> dict:
+    """``{a="x",b="y"}`` body (no braces) -> dict, undoing escapes."""
+    out = {}
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq]
+        assert s[eq + 1] == '"'
+        i = eq + 2
+        val = []
+        while s[i] != '"':
+            if s[i] == "\\":
+                nxt = s[i + 1]
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+            else:
+                val.append(s[i])
+                i += 1
+        out[name] = "".join(val)
+        i += 1  # closing quote
+        if i < len(s):
+            assert s[i] == ","
+            i += 1
+    return out
+
+
+def _parse_exposition(text: str) -> dict:
+    """Prometheus text -> {sample_name: [(labels_dict, float_value)]}."""
+    samples: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            assert rest.endswith("}")
+            labels = _parse_labels(rest[:-1])
+        else:
+            name, labels = metric, {}
+        v = float("inf") if value == "+Inf" else float(value)
+        samples.setdefault(name, []).append((labels, v))
+    return samples
+
+
+class TestExposition:
+    def test_label_escaping_round_trip(self):
+        raw = 'back\\slash "quoted"\nnewline'
+        c = Counter("esc_total", "h", labels=("path",), registry=None)
+        c.inc(raw)
+        samples = _parse_exposition(c.render())
+        (labels, value), = samples["esc_total"]
+        assert labels == {"path": raw}
+        assert value == 1
+
+    def test_histogram_buckets_cumulative_and_inf(self):
+        h = Histogram("e_seconds", "h", buckets=[0.1, 1.0, 10.0],
+                      labels=("route",), registry=None)
+        for v in (0.05, 0.05, 0.5, 5.0, 500.0):
+            h.observe("r1", v)
+        samples = _parse_exposition(h.render())
+        buckets = [
+            (labels["le"], val)
+            for labels, val in samples["e_seconds_bucket"]
+            if labels["route"] == "r1"
+        ]
+        # rendered in ascending-bound order, counts monotone nondecreasing
+        counts = [val for _, val in buckets]
+        assert counts == sorted(counts)
+        by_le = dict(buckets)
+        assert by_le["0.1"] == 2
+        assert by_le["1"] == 3
+        assert by_le["10"] == 4
+        (_, count_val), = samples["e_seconds_count"]
+        assert by_le["+Inf"] == count_val == 5
+
+    def test_histogram_sum_formatting(self):
+        h = Histogram("s_seconds", "h", buckets=[1.0], registry=None)
+        h.observe(0.25)
+        h.observe(0.5)
+        samples = _parse_exposition(h.render())
+        (_, sum_val), = samples["s_seconds_sum"]
+        assert sum_val == pytest.approx(0.75)
+        # integral sums render without a trailing .0 (repr(int) path) but
+        # must still parse as the same float
+        h2 = Histogram("s2_seconds", "h", buckets=[10.0], registry=None)
+        h2.observe(2)
+        h2.observe(3)
+        text = h2.render()
+        assert "s2_seconds_sum 5\n" in text
+        (_, sum2), = _parse_exposition(text)["s2_seconds_sum"]
+        assert sum2 == 5.0

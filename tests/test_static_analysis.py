@@ -633,6 +633,90 @@ def test_cross_thread_edges_detect_inversion(monkeypatch):
     racecheck.REGISTRY.reset()
 
 
+# --- log-discipline ---------------------------------------------------------
+
+
+def test_bare_print_in_library_flagged():
+    fs = run_src(
+        """
+        def handler(x):
+            print("served", x)
+        """
+    )
+    assert rules_of(fs) == ["log-discipline"]
+
+
+def test_basic_config_in_library_flagged():
+    fs = run_src(
+        """
+        import logging
+
+        def setup():
+            logging.basicConfig(level=logging.INFO)
+        """
+    )
+    assert rules_of(fs) == ["log-discipline"]
+
+
+def test_module_logger_is_clean():
+    fs = run_src(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def handler(x):
+            log.info("served %s", x)
+        """
+    )
+    assert fs == []
+
+
+def test_cli_entrypoints_exempt():
+    src = """
+    import logging
+
+    def main():
+        logging.basicConfig(level=logging.INFO)
+        print("ready")
+    """
+    for path in ("pkg/__main__.py", "pkg/ctl.py", "bench.py",
+                 "__graft_entry__.py", "scripts/tool.py",
+                 "tests/test_thing.py"):
+        assert run_src(src, path=path) == []
+    assert rules_of(run_src(src, path="pkg/server.py")) == [
+        "log-discipline", "log-discipline",
+    ]
+
+
+def test_shadowed_print_is_not_the_builtin():
+    fs = run_src(
+        """
+        def render(print):
+            print("not the builtin")
+
+        class W:
+            def print(self):
+                pass
+
+            def go(self):
+                self.print()
+        """
+    )
+    assert fs == []
+
+
+def test_log_discipline_allow_suppresses():
+    fs = run_src(
+        """
+        def main():
+            # lint: allow[log-discipline] process entrypoint owns stdout
+            print("ready")
+        """
+    )
+    assert fs == []
+
+
 # --- the tier-1 gate --------------------------------------------------------
 
 
