@@ -406,9 +406,21 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
         2.0 * cfg.num_hidden_layers * live_len
         * cfg.num_key_value_heads * cfg.head_dim * 2.0
     )
-    decode_bytes_per_s = (2.0 * n_params + kv_read_bytes) / (
-        per_step_ms / 1e3
+    # the serving engine resolves KV through per-row block tables
+    # (batching paged pool): each layer's decode kernel additionally
+    # prefetches the row's live i32 table entries. Folded in so the
+    # published roofline models the serving layout — numerically
+    # negligible next to the KV read (4 bytes per live BLOCK vs ~1KB+
+    # per live token), but the fraction should account for every
+    # stream the serving step issues.
+    from kubeinfer_tpu.inference.batching import DEFAULT_BLOCK_SIZE
+
+    table_read_bytes = 4.0 * cfg.num_hidden_layers * float(
+        np.ceil(live_len / DEFAULT_BLOCK_SIZE)
     )
+    decode_bytes_per_s = (
+        2.0 * n_params + kv_read_bytes + table_read_bytes
+    ) / (per_step_ms / 1e3)
 
     pf_dt = max(
         statistics.median(pf_longs) - statistics.median(pf_shorts), 1e-9
@@ -524,8 +536,8 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
     }
 
 
-def serving_trace_bench(n_requests=16, prompt_len=64, max_new=8,
-                        n_slots=8, cache_len=256, model="bench-280m"):
+def serving_trace_bench(n_requests=16, prompt_len=256, max_new=8,
+                        n_slots=8, cache_len=512, model="bench-280m"):
     """Serving-latency breakdown sourced from the TRACE layer.
 
     Oversubscribes the continuous batcher (n_requests > n_slots) so
@@ -540,6 +552,25 @@ def serving_trace_bench(n_requests=16, prompt_len=64, max_new=8,
     TTFT here = queue_wait.start → prefill.end (submit to first
     token), the serving definition; it includes scheduler queueing,
     unlike the dispatch-level decode_ms_per_token keys.
+
+    Two phases share one engine (so the warm phase sees a realistic,
+    already-populated radix cache): a COLD phase of unrelated prompts
+    publishes ``ttft_ms_b8`` / ``queue_wait_ms_p99``; a WARM phase
+    whose prompts share a long system prefix planted beforehand
+    publishes ``ttft_ms_b8_prefix_hit`` plus ``prefix_hit_rate`` taken
+    from the engine's own kv_cache_stats deltas — the same counters
+    /metrics exports, for the same honesty reason as the spans.
+
+    This section pins itself to the host CPU backend. The quantities
+    here are scheduling-layer effects (queue wait, prefill width,
+    prefix reuse) read from span wall-clock, and the experimental axon
+    relay taxes EVERY dispatch with a ~70-130 ms jittery transport
+    round trip — larger than the effects under measurement and absent
+    on the production local attachment the BASELINE budget targets.
+    The solver headline cancels transport by chain differencing;
+    span-based wall-clock cannot, so this section removes it by
+    construction instead. The dispatch-level decode/prefill keys above
+    still run on the live backend.
     """
     import jax
     import jax.numpy as jnp
@@ -549,50 +580,106 @@ def serving_trace_bench(n_requests=16, prompt_len=64, max_new=8,
     from kubeinfer_tpu.observability import tracing
 
     cfg = PRESETS[model]
-    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
-    eng = ContinuousEngine(
-        params, cfg, n_slots=n_slots, cache_len=cache_len
-    ).start()
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
     try:
-        # warm the prefill bucket + decode step so span timings measure
-        # steady-state serving, not jit compiles
-        warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
-        eng.generate(warm, max_new_tokens=max_new)
-        _touch_progress()
-        tracing.RECORDER.clear()
-        reqs = [
-            eng.submit(
-                rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
-                max_new_tokens=max_new,
-            )
-            for _ in range(n_requests)
-        ]
-        for r in reqs:
-            if not r.done.wait(timeout=300):
-                raise TimeoutError("traced request timed out")
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+        # block_size 32 rather than the TPU-tiled 128 default: the
+        # shared prefix below then rounds down to 7 reusable blocks of
+        # the 8-block prompt, so warm admits prefill a 32-token bucket
+        # instead of the full 256 — an 8x prefill-compute cut, which is
+        # the effect ttft_ms_b8_prefix_hit exists to expose. (On CPU
+        # the paged decode path uses the jnp gather twin, which has no
+        # 128-lane tiling constraint.)
+        eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            block_size=32,
+        ).start()
+
+        def _measure(prompts):
+            tracing.RECORDER.clear()
+            reqs = [
+                eng.submit(p, max_new_tokens=max_new) for p in prompts
+            ]
+            for r in reqs:
+                if not r.done.wait(timeout=300):
+                    raise TimeoutError("traced request timed out")
+                _touch_progress()
+            spans = tracing.RECORDER.snapshot()
+            queue_by_trace = {
+                s.trace_id: s
+                for s in spans if s.name == "engine.queue_wait"
+            }
+            prefill_by_trace = {
+                s.trace_id: s
+                for s in spans if s.name == "engine.prefill"
+            }
+            ttfts = [
+                prefill_by_trace[tid].end - q.start
+                for tid, q in queue_by_trace.items()
+                if tid in prefill_by_trace
+            ]
+            waits = [s.duration() for s in queue_by_trace.values()]
+            if not ttfts or not waits:
+                raise RuntimeError(
+                    "trace layer recorded no serving spans"
+                )
+            return ttfts, waits
+
+        try:
+            # warm the cold prefill bucket + decode step so span
+            # timings measure steady-state serving, not jit compiles
+            warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+            eng.generate(warm, max_new_tokens=max_new)
             _touch_progress()
-        spans = tracing.RECORDER.snapshot()
+            cold_ttfts, waits = _measure([
+                rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+                for _ in range(n_requests)
+            ])
+
+            # WARM phase: all prompts = shared prefix + unique 8-token
+            # tail. Two unmeasured requests first: the plant (a miss —
+            # it writes the prefix blocks into the radix cache) and one
+            # hit, which compiles the short warm-suffix admit bucket so
+            # compile time stays out of the measured spans, mirroring
+            # the cold-phase warmup.
+            tail = 8
+            prefix = rng.integers(
+                0, cfg.vocab_size, prompt_len - tail
+            ).tolist()
+
+            def _tailed():
+                return prefix + rng.integers(
+                    0, cfg.vocab_size, tail
+                ).tolist()
+
+            eng.generate(_tailed(), max_new_tokens=max_new)
+            eng.generate(_tailed(), max_new_tokens=max_new)
+            _touch_progress()
+            before = eng.kv_cache_stats()
+            warm_ttfts, _ = _measure(
+                [_tailed() for _ in range(n_requests)]
+            )
+            after = eng.kv_cache_stats()
+        finally:
+            eng.stop()
     finally:
-        eng.stop()
-    queue_by_trace = {
-        s.trace_id: s for s in spans if s.name == "engine.queue_wait"
-    }
-    prefill_by_trace = {
-        s.trace_id: s for s in spans if s.name == "engine.prefill"
-    }
-    ttfts = [
-        prefill_by_trace[tid].end - q.start
-        for tid, q in queue_by_trace.items()
-        if tid in prefill_by_trace
-    ]
-    waits = [s.duration() for s in queue_by_trace.values()]
-    if not ttfts or not waits:
-        raise RuntimeError("trace layer recorded no serving spans")
+        jax.config.update("jax_default_device", prev_dev)
+    hit_delta = after["hits"] - before["hits"]
+    miss_delta = after["misses"] - before["misses"]
     return {
-        "ttft_ms_b8": round(statistics.median(ttfts) * 1e3, 3),
+        "ttft_ms_b8": round(statistics.median(cold_ttfts) * 1e3, 3),
         "queue_wait_ms_p99": round(
             float(np.percentile(np.asarray(waits), 99)) * 1e3, 3
+        ),
+        "ttft_ms_b8_prefix_hit": round(
+            statistics.median(warm_ttfts) * 1e3, 3
+        ),
+        "prefix_hit_rate": round(
+            hit_delta / max(hit_delta + miss_delta, 1), 3
         ),
     }
 
@@ -977,6 +1064,8 @@ def main() -> None:
             tr = serving_trace_bench(n_slots=8)
             extras["ttft_ms_b8"] = tr["ttft_ms_b8"]
             extras["queue_wait_ms_p99"] = tr["queue_wait_ms_p99"]
+            extras["ttft_ms_b8_prefix_hit"] = tr["ttft_ms_b8_prefix_hit"]
+            extras["prefix_hit_rate"] = tr["prefix_hit_rate"]
         except Exception as e:
             extras["serving_trace_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)

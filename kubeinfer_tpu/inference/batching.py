@@ -4,16 +4,25 @@ The reference delegates serving entirely to vLLM, whose headline
 scheduler feature is continuous batching; this is the TPU-native
 equivalent, built from static shapes:
 
-- A fixed pool of B decode **slots**, each owning a [S] stripe of the
-  layered KV cache. All device state (caches, last tokens, offsets,
-  actives) lives in one ``SlotState`` pytree that never changes shape.
+- A fixed pool of B decode **slots**. KV lives in a shared PAGED pool:
+  per-layer [num_blocks, block_size, n_kv, D] tensors plus a static
+  i32[B, max_blocks] block table per slot (vLLM's PagedAttention
+  layout, Kwon et al. 2023). All device state lives in one
+  ``SlotState`` pytree that never changes shape; every
+  allocation/refcount/free decision is host-side (kv_blocks.py),
+  between device steps.
 - ``decode_step`` advances EVERY active slot one token in ONE jitted
-  call — compiled exactly once. Per-slot cache writes use vmapped
-  dynamic_update_slice (per-row offsets), per-slot RoPE positions come
-  from the offsets, and inactive slots are masked.
-- New requests **prefill into a free slot** (compiled once per prompt
-  bucket) while other slots keep decoding — no barrier between
-  admission and the running batch beyond the step granularity.
+  call — compiled exactly once. The step's K/V land via one batched
+  scatter through the block tables; attention reads the pool through
+  the same tables (flash_attention.decode_attention_blocks_auto).
+- New requests **prefill into a free slot** (compiled once per SUFFIX
+  bucket) while other slots keep decoding. A host-side radix cache
+  (kv_blocks.RadixCache, SGLang's RadixAttention idea) matches the
+  longest full-block prompt prefix already in the pool: matched blocks
+  join the slot's table by refcount bump and prefill starts at the
+  matched offset, so a warm system prompt pays only its novel suffix.
+  The partial tail block is never shared — it is recomputed into a
+  fresh block (copy-on-write by construction).
 
 The scheduler loop itself (admit → step → emit/retire) is plain Python
 in the serving thread: decisions are O(slots) host work between device
@@ -35,6 +44,7 @@ import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
+from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.observability import tracing
@@ -47,15 +57,28 @@ _TRACER = tracing.get_tracer("engine")
 # long completion cannot dominate the span ring's memory
 _MAX_TOKEN_EVENTS = 128
 
+# pool block width (tokens). 128 keeps each block lane-aligned so the
+# block-table Pallas kernel's tiles are MXU-shaped
+# (flash_attention.decode_blocks_available); engines whose cache_len is
+# smaller clamp down and take the gather+dense fallback.
+DEFAULT_BLOCK_SIZE = 128
+
 # --- device state ----------------------------------------------------------
 
 
 @dataclass
 class SlotState:
-    """All device-resident decode state (fixed shapes)."""
+    """All device-resident decode state (fixed shapes).
 
-    caches_k: list[jax.Array]  # L x [B, S, n_kv, D]
+    The KV pool is SHARED across slots: row b's logical cache position
+    p lives in ``caches_k[l][tables[b, p // bs], p % bs]``. Block 0 is
+    the reserved null block (kv_blocks.NULL_BLOCK): dead table entries
+    and retired rows point there, so every gather/scatter index is
+    always valid without data-dependent control flow under jit."""
+
+    caches_k: list[jax.Array]  # L x [num_blocks, block_size, n_kv, D]
     caches_v: list[jax.Array]
+    tables: jax.Array  # i32[B, max_blocks] pool indices, seq order
     last_token: jax.Array  # i32[B]
     offset: jax.Array  # i32[B] next cache position (= current length)
     active: jax.Array  # bool[B]
@@ -69,19 +92,20 @@ class SlotState:
 
 jax.tree_util.register_dataclass(
     SlotState,
-    data_fields=["caches_k", "caches_v", "last_token", "offset", "active",
-                 "temperature", "top_k", "top_p", "rep_penalty",
+    data_fields=["caches_k", "caches_v", "tables", "last_token", "offset",
+                 "active", "temperature", "top_k", "top_p", "rep_penalty",
                  "seen", "rng"],
     meta_fields=[],
 )
 
 
 def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
-                dtype) -> SlotState:
-    shape = (n_slots, cache_len, cfg.num_key_value_heads, cfg.head_dim)
+                dtype, num_blocks: int, block_size: int) -> SlotState:
+    shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
     return SlotState(
         caches_k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
         caches_v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
+        tables=jnp.zeros((n_slots, cache_len // block_size), jnp.int32),
         last_token=jnp.zeros((n_slots,), jnp.int32),
         offset=jnp.zeros((n_slots,), jnp.int32),
         active=jnp.zeros((n_slots,), bool),
@@ -146,15 +170,19 @@ def _decode_step(
     cache/offset/token state is preserved unchanged.
     """
     B = state.last_token.shape[0]
-    S = state.caches_k[0].shape[1]
+    block_size = state.caches_k[0].shape[1]
+    S = state.tables.shape[1] * block_size  # logical per-row cache width
     mask = (jnp.arange(S)[None, None, :] < (state.offset + 1)[:, None, None])
     mask = jnp.broadcast_to(mask, (B, 1, S))
 
-    # model.forward handles per-row cache offsets natively (decoder_layer
-    # scatter-writes when cache_offset is a vector); on TPU the decode
-    # kernel reads only each slot's live KV tiles (lengths == the mask's
-    # live set), dense fallback elsewhere
-    from kubeinfer_tpu.inference.flash_attention import decode_attention_auto
+    # the step's K/V scatter through the block tables (decoder_layer's
+    # paged branch); attention reads the pool through the same tables —
+    # the block-table Pallas kernel on TPU DMAs only each row's live
+    # blocks (and shared prefix blocks once per consecutive reuse),
+    # gather + dense fallback elsewhere
+    from kubeinfer_tpu.inference.flash_attention import (
+        decode_attention_blocks_auto,
+    )
 
     logits, caches = forward(
         params,
@@ -164,8 +192,9 @@ def _decode_step(
         attn_mask=mask,
         kv_caches=list(zip(state.caches_k, state.caches_v)),
         cache_offset=state.offset,
-        attn_fn=lambda q, k, v, m: decode_attention_auto(
-            q, k, v, state.offset + 1, m
+        block_tables=state.tables,
+        attn_fn=lambda q, k, v, m: decode_attention_blocks_auto(
+            q, k, v, state.tables, state.offset + 1, m
         ),
     )
     new_k = [c[0] for c in caches]
@@ -185,14 +214,13 @@ def _decode_step(
     # such copies before the conversion)
     new_state = dataclasses.replace(
         state,
-        caches_k=[
-            jnp.where(keep[:, None, None, None], nk, ok)
-            for nk, ok in zip(new_k, state.caches_k)
-        ],
-        caches_v=[
-            jnp.where(keep[:, None, None, None], nv, ov)
-            for nv, ov in zip(new_v, state.caches_v)
-        ],
+        # no keep-masking on the pool: a retired slot's table row is
+        # all-null (see _maybe_retire), so an inactive row's scatter
+        # lands in the sacrificial block 0 and the pool is taken as-is
+        # (a per-row where over a SHARED pool would be wrong anyway —
+        # rows no longer own disjoint stripes)
+        caches_k=new_k,
+        caches_v=new_v,
         last_token=jnp.where(keep, nxt, state.last_token),
         offset=jnp.where(keep, state.offset + 1, state.offset),
         # record_seen self-gates on any-penalty-enabled; masking by
@@ -210,56 +238,81 @@ def _decode_step(
 def _admit_slot(
     params: Params,
     state: SlotState,
-    prompt: jax.Array,  # i32[1, T_bucket]
-    prompt_len: jax.Array,  # i32[]
+    suffix: jax.Array,  # i32[1, T_bucket] prompt tokens from ``start`` on
+    suffix_len: jax.Array,  # i32[] live tokens in ``suffix``
+    start: jax.Array,  # i32[] matched-prefix length (0 = cold admit)
+    prompt_len: jax.Array,  # i32[] full prompt length (= start + suffix_len)
     cfg: ModelConfig,
     slot: jax.Array,  # i32[] — traced, or admission compiles per slot
+    table_row: jax.Array,  # i32[max_blocks] this slot's block table
+    own_mask: jax.Array,  # bool[max_blocks] True = freshly allocated block
     temperature: jax.Array,  # f32[]
     top_k: jax.Array,  # i32[]
     top_p: jax.Array,  # f32[]
     rep_penalty: jax.Array,  # f32[]
     key_data: jax.Array,  # u32[2] per-request PRNG key data
+    seen_row: jax.Array,  # bool[1, V] host-computed full-prompt id set
 ) -> SlotState:
-    """Prefill one request into slot ``slot`` (compiled per T bucket)."""
-    T = prompt.shape[1]
-    S = state.caches_k[0].shape[1]
-    pos = jnp.arange(T)
-    valid = pos[None, :] < prompt_len
-    mask = (pos[None, None, :] <= pos[None, :, None]) & valid[:, None, :]
-    mask = jnp.concatenate(
-        [mask, jnp.zeros((1, T, S - T), bool)], axis=2
+    """Prefill one request's novel suffix into the pool blocks of
+    ``table_row`` (compiled per SUFFIX bucket — a warm admit of a long
+    prompt compiles and runs the short-suffix trace).
+
+    Shape of the trick: gather the row's logical cache view through the
+    table (shared prefix blocks arrive with their KV already computed),
+    run the dense prefill over the suffix window at ``cache_offset=
+    start`` with RoPE positions ``start + arange(T)``, then scatter the
+    updated view back — but ONLY into blocks this admit owns
+    (``own_mask``): shared blocks are never rewritten (copy-on-write),
+    and the null padding past the row's last block is left alone so
+    duplicate scatter indices all carry the block's current value
+    (deterministic by construction). Masked positions of the gathered
+    view contribute exactly 0 to attention, so a cold admit here is
+    bit-identical to the pre-paging dense prefill."""
+    T = suffix.shape[1]
+    nb, bs, n_kv, D = state.caches_k[0].shape
+    M = table_row.shape[0]
+    S = M * bs  # logical per-row width == engine cache_len
+    q_pos = start + jnp.arange(T)
+    cache_pos = jnp.arange(S)
+    # causal over logical positions, limited to the real prompt: key
+    # slots past prompt_len (pad tail and decode room) are masked; the
+    # shared-prefix slots < start are always visible
+    mask = (
+        (cache_pos[None, None, :] <= q_pos[None, :, None])
+        & (cache_pos[None, None, :] < prompt_len)
     )
     caches = [
         (
-            jnp.zeros((1, S, cfg.num_key_value_heads, cfg.head_dim),
-                      state.caches_k[0].dtype),
-            jnp.zeros((1, S, cfg.num_key_value_heads, cfg.head_dim),
-                      state.caches_v[0].dtype),
+            ck[table_row].reshape(1, S, n_kv, D),
+            cv[table_row].reshape(1, S, n_kv, D),
         )
-        for _ in range(cfg.num_hidden_layers)
+        for ck, cv in zip(state.caches_k, state.caches_v)
     ]
     logits, caches = forward(
-        params, prompt, cfg, attn_mask=mask, kv_caches=caches, cache_offset=0
+        params, suffix, cfg, positions=q_pos[None, :], attn_mask=mask,
+        kv_caches=caches, cache_offset=start,
     )
-    from kubeinfer_tpu.inference.engine import seen_from_prompt
 
-    last = jnp.clip(prompt_len - 1, 0, T - 1)
-    seen_row = seen_from_prompt(prompt, prompt_len[None], cfg.vocab_size)
+    last = jnp.clip(suffix_len - 1, 0, T - 1)
     first = _sample_rows(
         logits[:, last], temperature[None], top_k[None], top_p[None],
         rep_penalty[None], seen_row, key_data[None], prompt_len[None],
     )[0]
     seen_row = record_seen(seen_row, first[None], rep_penalty[None])
 
-    def put(big, small):
-        return jax.lax.dynamic_update_slice(
-            big, small, (slot, 0, 0, 0)
+    own = own_mask[:, None, None, None]
+
+    def put(pool, view):
+        new_blocks = view.reshape(M, bs, n_kv, D)
+        return pool.at[table_row].set(
+            jnp.where(own, new_blocks, pool[table_row])
         )
 
     return dataclasses.replace(
         state,
         caches_k=[put(b, c[0]) for b, c in zip(state.caches_k, caches)],
         caches_v=[put(b, c[1]) for b, c in zip(state.caches_v, caches)],
+        tables=state.tables.at[slot].set(table_row),
         last_token=state.last_token.at[slot].set(first),
         offset=state.offset.at[slot].set(prompt_len),
         active=state.active.at[slot].set(True),
@@ -333,11 +386,44 @@ class ContinuousEngine:
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  n_slots: int = 8, cache_len: int = 1024,
-                 speculative=None) -> None:
+                 speculative=None, block_size: int | None = None,
+                 num_blocks: int | None = None) -> None:
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.cache_len = cache_len
+        # paged KV: block width defaults to the kernel-aligned size,
+        # clamped for small test caches (which then take the
+        # gather+dense fallback path)
+        self.block_size = block_size if block_size is not None else min(
+            DEFAULT_BLOCK_SIZE, cache_len
+        )
+        if cache_len % self.block_size:
+            raise ValueError(
+                f"cache_len {cache_len} must be a multiple of block_size "
+                f"{self.block_size}"
+            )
+        self.max_blocks = cache_len // self.block_size
+        if num_blocks is None:
+            # 2x slot capacity (+ the reserved null block): the surplus
+            # is what the radix cache retains between requests — with
+            # exactly slot capacity every admit would evict the prefix
+            # it hopes to reuse
+            num_blocks = 1 + 2 * n_slots * self.max_blocks
+        if num_blocks < 1 + n_slots * self.max_blocks:
+            # below this floor a full-length request could find the pool
+            # permanently short even after evicting the whole trie (its
+            # blocks pinned by other slots) — the holdover would starve
+            raise ValueError(
+                f"num_blocks {num_blocks} < 1 + n_slots * max_blocks "
+                f"({1 + n_slots * self.max_blocks}): a request could "
+                "never admit"
+            )
+        self._pool = BlockPool(num_blocks, self.block_size)
+        self._radix = RadixCache(self._pool)
+        # host copy of each slot's owned block ids (shared + fresh), in
+        # table order — what retire returns to the pool
+        self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
         # Optional SpeculativeEngine: draft-eligible requests decode
         # through an INCREMENTAL draft group (speculative.start_group /
         # step_group) that interleaves with busy slots one round at a
@@ -357,7 +443,8 @@ class ContinuousEngine:
         # (no free slot / not group-joinable); served before the queue
         self._holdover: _Request | None = None
         self._state = _init_state(
-            cfg, n_slots, cache_len, params["norm"].dtype
+            cfg, n_slots, cache_len, params["norm"].dtype,
+            num_blocks, self.block_size,
         )
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slot_req: list[_Request | None] = [None] * n_slots
@@ -437,6 +524,17 @@ class ContinuousEngine:
             seed=seed, top_k=top_k, top_p=top_p,
             repetition_penalty=repetition_penalty, timeout=timeout,
         ).out_tokens
+
+    def kv_cache_stats(self) -> dict:
+        """Point-in-time paged-KV accounting for /metrics: pool
+        occupancy plus the radix cache's monotonic hit/miss/eviction
+        counters (the server turns the latter into Prometheus counters
+        by delta at scrape time). Callable from any thread — the pool
+        and trie take their own locks."""
+        stats = self._radix.stats()
+        stats["blocks_in_use"] = self._pool.used_blocks
+        stats["blocks_free"] = self._pool.free_blocks
+        return stats
 
     def prewarm_spec(self, group_sizes: tuple[int, ...] = (1,),
                      prompt_len: int = 8, max_new_tokens: int = 8,
@@ -520,15 +618,62 @@ class ContinuousEngine:
 
     # -- scheduler loop ---------------------------------------------------
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _plan_kv(self, req: "_Request"):
+        """Host-side paged-admit plan: radix match → capacity clamp →
+        evict/alloc. Returns ``(table_row, own_mask, reuse, total)`` —
+        the static-shape operands ``_admit_slot`` needs — or None when
+        the pool cannot supply the fresh blocks (admission
+        backpressure; unreachable with the __init__ sizing floor but
+        kept for custom pools). On success the slot holds one reference
+        per block in ``table_row[:total]``."""
+        p = len(req.prompt)
+        bs = self.block_size
+        matched = self._radix.match(req.prompt)  # +1 ref each, ours now
+        # full blocks only, and never the whole prompt: the last token
+        # must be recomputed so the admit has logits to sample from
+        reuse = min(len(matched), (p - 1) // bs)
+        # the suffix pads to a bucket and the prefill window must fit
+        # the logical cache: shrinking reuse widens the recompute
+        # window, terminating by submit()'s guarantee that the cold
+        # bucket fits. Buckets stay canonical (engine._bucket) so warm
+        # admits share the cold traces' compile cache.
+        while reuse > 0 and reuse * bs + _bucket(p - reuse * bs) > \
+                self.cache_len:
+            reuse -= 1
+        if reuse < len(matched):
+            self._pool.unref(matched[reuse:])
+        shared = matched[:reuse]
+        total = -(-(p + req.max_new) // bs)  # ceil; fits() bounds it
+        if not self._radix.ensure_free(total - reuse):
+            if shared:
+                self._pool.unref(shared)
+            return None
+        fresh = self._pool.alloc(total - reuse)
+        self._radix.note_result(reuse)
+        table_row = np.zeros(self.max_blocks, np.int32)
+        table_row[:reuse] = shared
+        table_row[reuse:total] = fresh
+        own_mask = np.zeros(self.max_blocks, bool)
+        own_mask[reuse:total] = True
+        return table_row, own_mask, reuse, total
+
+    def _admit(self, slot: int, req: _Request, kv_plan) -> None:
+        table_row, own_mask, reuse, total = kv_plan
+        p = len(req.prompt)
+        start = reuse * self.block_size
+        suffix_len = p - start
         req.t_admit = tracing.now()
         _TRACER.record_span(
             "engine.queue_wait", start=req.t_submit, end=req.t_admit,
             parent=req.trace_parent, slot=slot,
         )
-        T = _bucket(len(req.prompt))  # submit() guarantees T <= cache_len
+        T = _bucket(suffix_len)  # _plan_kv guarantees start + T <= cache_len
         padded = np.zeros((1, T), np.int32)
-        padded[0, : len(req.prompt)] = req.prompt
+        padded[0, :suffix_len] = req.prompt[start:]
+        # full-prompt id set computed host-side: the jit only sees the
+        # suffix, but repetition penalty must cover reused tokens too
+        seen_row = np.zeros((1, self.cfg.vocab_size), bool)
+        seen_row[0, np.asarray(req.prompt, np.int64)] = True
         # explicit impl: _sample_rows wraps with threefry2x32 and
         # SlotState.rng is u32[B, 2]; deriving from the default-impl
         # PRNGKey would break under jax_default_prng_impl=rbg (u32[4])
@@ -537,11 +682,21 @@ class ContinuousEngine:
         ).astype(jnp.uint32)
         self._state = _admit_slot(
             self.params, self._state, jnp.asarray(padded),
-            jnp.int32(len(req.prompt)), self.cfg, jnp.int32(slot),
+            jnp.int32(suffix_len), jnp.int32(start), jnp.int32(p),
+            self.cfg, jnp.int32(slot),
+            jnp.asarray(table_row), jnp.asarray(own_mask),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
             jnp.float32(req.top_p), jnp.float32(req.rep_penalty), key_data,
+            jnp.asarray(seen_row),
         )
         self._slot_req[slot] = req
+        self._slot_blocks[slot] = [int(b) for b in table_row[:total]]
+        # cache the prompt's FULL blocks for later admits — including
+        # this one's fresh prefix blocks (their KV is committed by the
+        # scatter above; the partial tail block stays private)
+        full = p // self.block_size
+        if full:
+            self._radix.insert(req.prompt, [int(b) for b in table_row[:full]])
         # the prefill already produced the first generated token
         # lint: allow[host-sync] admission boundary: the first token must reach the request result now
         first = int(self._state.last_token[slot])
@@ -551,6 +706,7 @@ class ContinuousEngine:
         sp = _TRACER.start_span(
             "engine.prefill", parent=req.trace_parent, start=req.t_admit,
             slot=slot, prompt_tokens=len(req.prompt), bucket=T,
+            reused_tokens=start, prefix_hit=reuse > 0,
         )
         sp.event("first-token", ts=req.t_first)
         _TRACER.finish(sp, end=req.t_first)
@@ -570,9 +726,18 @@ class ContinuousEngine:
         )
         if finished:
             self._slot_req[slot] = None
+            blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+            if blocks:
+                # drop the slot's hold; blocks also cached in the trie
+                # keep the trie's reference and stay reusable
+                self._pool.unref(blocks)
             self._state = dataclasses.replace(
                 self._state,
                 active=self._state.active.at[slot].set(False),
+                # the row's table goes all-null BEFORE its next decode
+                # scatter: freed blocks may be re-issued to another
+                # slot, and a stale table would keep writing into them
+                tables=self._state.tables.at[slot].set(0),
             )
             req.t_done = tracing.now()
             sp = _TRACER.start_span(
@@ -748,7 +913,10 @@ class ContinuousEngine:
         with self._lock:
             for slot in range(self.n_slots):
                 if self._slot_req[slot] is None:
-                    self._admit(slot, req)
+                    kv_plan = self._plan_kv(req)
+                    if kv_plan is None:
+                        break  # pool backpressure: hold until a retire
+                    self._admit(slot, req, kv_plan)
                     return True
             self._holdover = req
         return False

@@ -614,6 +614,263 @@ def decode_attention_auto(q, k, v, lengths, mask):
     return dense_attention(q, k, v, mask)
 
 
+# --- block-table (paged) decode attention ----------------------------------
+#
+# The serving engine's KV lives in a shared pool [num_blocks, block_size,
+# n_kv, D]; each batch row owns an i32[max_blocks] table naming its blocks
+# in sequence order. The decode kernel below is _decode_attn_kernel with
+# one change: the k/v index_map resolves the S-tile index through the
+# scalar-prefetched table, so a tile IS a pool block and rows sharing a
+# prefix DMA the same physical blocks. No reference counterpart — the
+# reference hands paging to the vLLM subprocess (vllm.go:93-112); vLLM's
+# PagedAttention (Kwon et al. 2023) is the design source.
+#
+# Table contract: EVERY entry of every row — including entries past the
+# row's live blocks — must be a valid pool index (the host pads with the
+# reserved null block 0). Dead entries are never folded (same exact-zero
+# skip as the linear kernel) but the index_map still names them when
+# row_len == 0, and the twin gathers them unconditionally.
+
+
+def _decode_blocks_kernel(
+    tbl_ref,  # scalar-prefetch i32[B, max_blocks]: per-row block tables
+    len_ref,  # scalar-prefetch i32[B]: per-row live lengths
+    q_ref,  # [1, G, D] — the row's single query, groups as MXU rows
+    k_ref,  # [1, 1, block_size, D] — one pool block for one kv head
+    v_ref,  # [1, 1, block_size, D]
+    o_ref,  # [1, G, D] out
+    m_scr,  # f32[G, 1]
+    l_scr,  # f32[G, 1]
+    acc_scr,  # f32[G, D]
+    *,
+    groups: int,
+    scale: float,
+    n_blocks: int,
+    block_size: int,
+    n_kv: int,
+):
+    """_decode_attn_kernel over a paged cache: the grid's S axis walks
+    the row's block table (resolved in the index_map — tbl_ref is unused
+    here) and the penalty is derived from the LOGICAL position
+    ts * block_size + i, so the fold math is position-for-position the
+    linear kernel's. Same bit-identical skip/clamp story: a tile past
+    the live length folds exactly 0, row_len == 0 rows stay dense over
+    whatever their (null-padded) table names."""
+    del tbl_ref  # consumed by the BlockSpec index_map, not the body
+    row_len = len_ref[pl.program_id(0) // n_kv]
+    ts = pl.program_id(1)  # innermost: table walk with resident scratch
+
+    @pl.when(ts == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when((ts == 0) | (row_len == 0) | (ts * block_size < row_len))
+    def _fold():
+        s_pos = ts * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        pen = jnp.where(s_pos < row_len, 0.0, -1e30)
+        m_new, l_new, acc_new = _fold_tile_math(
+            q_ref[0], k_ref[0, 0], v_ref[0, 0], pen,
+            m_scr[:], l_scr[:], acc_scr[:],
+            groups=groups, scale=scale,
+        )
+        l_scr[:] = l_new
+        acc_scr[:] = acc_new
+        m_scr[:] = m_new
+
+    @pl.when(ts == n_blocks - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def decode_attention_blocks(
+    q: jax.Array,  # [B, 1, n_heads, D] — one new token per row
+    k_pool: jax.Array,  # [num_blocks, block_size, n_kv, D] shared pool
+    v_pool: jax.Array,  # [num_blocks, block_size, n_kv, D]
+    block_tables: jax.Array,  # i32[B, max_blocks]: pool indices, seq order
+    lengths: jax.Array,  # i32[B]: live entries per row (offset + 1)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged decode attention: row b's logical cache position p lives in
+    pool block block_tables[b, p // block_size] at slot p % block_size.
+    Both scalar operands prefetch; the k/v index_map clamps the table
+    walk past each row's last live block (same DMA-elision contract as
+    decode_attention) and then indirects through the table, so shared
+    prefix blocks are fetched once per consecutive reuse rather than
+    duplicated per row. Twin: decode_attention_blocks_jnp
+    (bit-identical, parity-tested in tests/test_flash_attention.py)."""
+    B, T, n_heads, D = q.shape
+    if T != 1:
+        raise ValueError(
+            f"decode_attention_blocks is T == 1 only; got T={T}"
+        )
+    num_blocks, block_size, n_kv = k_pool.shape[:3]
+    max_blocks = block_tables.shape[1]
+    G = n_heads // n_kv
+
+    qf = q.reshape(B, n_kv, G, D).reshape(B * n_kv, G, D)
+    # [num_blocks, n_kv, block_size, D]: one (block, head) pair per tile
+    kp = k_pool.transpose(0, 2, 1, 3)
+    vp = v_pool.transpose(0, 2, 1, 3)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    def _kv_map(bh, ts, tbl_ref, lens_ref, n_kv=n_kv, bs=block_size):
+        # Same clamp as decode_attention's _kv_map, then the table
+        # lookup: dead steps re-name the row's last live block so
+        # Pallas elides their DMAs. row_len == 0 rows walk their true
+        # (null-padded) table — their defined output is the uniform
+        # average over what the table names, mirroring the twin.
+        b = bh // n_kv
+        rl = lens_ref[b]
+        live_last = jnp.maximum(rl - 1, 0) // bs
+        step = jnp.where(rl == 0, ts, jnp.minimum(ts, live_last))
+        return (tbl_ref[b, step], bh % n_kv, 0, 0)
+
+    q_spec = pl.BlockSpec(
+        (1, G, D), lambda bh, ts, tbl_ref, lens_ref: (bh, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_size, D), _kv_map, memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_blocks_kernel, groups=G, scale=1.0 / float(D) ** 0.5,
+            n_blocks=max_blocks, block_size=block_size, n_kv=n_kv,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * n_kv, max_blocks),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, G, D), q.dtype),
+        interpret=interpret,
+    )(tbl, lens, qf, kp, vp)
+    return out.reshape(B, 1, n_heads, D)
+
+
+def decode_attention_blocks_jnp(
+    q: jax.Array,  # [B, 1, n_heads, D]
+    k_pool: jax.Array,  # [num_blocks, block_size, n_kv, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # i32[B, max_blocks]
+    lengths: jax.Array,  # i32[B]
+) -> jax.Array:
+    """The block kernel's jnp twin: the SAME _fold_tile_math walked
+    per-row with lax.map and per-block with lax.scan, gathering each
+    tile through the row's table exactly as the kernel's index_map does
+    (minus the clamp — dead tiles fold exactly 0 either way, see
+    decode_attention_jnp's note). Because a gathered block holds the
+    same values as the linear cache's corresponding tile, this twin is
+    also bitwise equal to decode_attention_jnp(tile_s=block_size) on
+    the gathered cache — parity-tested both ways."""
+    B, T, n_heads, D = q.shape
+    if T != 1:
+        raise ValueError(
+            f"decode_attention_blocks_jnp is T == 1 only; got T={T}"
+        )
+    block_size, n_kv = k_pool.shape[1], k_pool.shape[2]
+    max_blocks = block_tables.shape[1]
+    G = n_heads // n_kv
+    BH = B * n_kv
+    scale = 1.0 / float(D) ** 0.5
+
+    qf = q.reshape(B, n_kv, G, D).reshape(BH, G, D)
+    kp = k_pool.transpose(0, 2, 1, 3)  # [num_blocks, n_kv, bs, D]
+    vp = v_pool.transpose(0, 2, 1, 3)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    row_tbl = jnp.repeat(tbl, n_kv, axis=0)  # [BH, max_blocks]
+    row_head = jnp.tile(jnp.arange(n_kv, dtype=jnp.int32), B)  # [BH]
+    row_len = jnp.repeat(jnp.asarray(lengths, jnp.int32), n_kv)  # [BH]
+
+    def _row(args):
+        qr, trow, h, rl = args  # [G, D], i32[max_blocks], i32, i32
+
+        def step(carry, ts):
+            m, l, acc = carry
+            k_t = kp[trow[ts], h]  # [bs, D] — the kernel's tile, gathered
+            v_t = vp[trow[ts], h]
+            s_pos = ts * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_size), 1
+            )
+            pen = jnp.where(s_pos < rl, 0.0, -1e30)
+            return _fold_tile_math(
+                qr, k_t, v_t, pen, m, l, acc, groups=G, scale=scale
+            ), None
+
+        init = (
+            jnp.full((G, 1), -1e30, jnp.float32),
+            jnp.zeros((G, 1), jnp.float32),
+            jnp.zeros((G, D), jnp.float32),
+        )
+        (m, l, acc), _ = jax.lax.scan(
+            step, init, jnp.arange(max_blocks, dtype=jnp.int32)
+        )
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    out = jax.lax.map(_row, (qf, row_tbl, row_head, row_len))
+    return out.reshape(B, 1, n_heads, D)
+
+
+def gather_block_kv(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """[num_blocks, bs, n_kv, D] pool -> [B, max_blocks * bs, n_kv, D]
+    per-row linear view through the tables — the dense-fallback (and
+    warm-prefill) materialization of what the block kernel reads
+    in-place. One XLA gather; rows sharing blocks duplicate them here,
+    which is exactly the copy the paged kernel exists to avoid."""
+    nb, bs, n_kv, D = pool.shape
+    B, M = block_tables.shape
+    return pool[block_tables].reshape(B, M * bs, n_kv, D)
+
+
+def decode_blocks_available(block_size: int, D: int) -> bool:
+    """Shapes the block kernel handles on the current default backend —
+    decode_flash_available's contract with S replaced by the pool's
+    block_size (each tile is one block, so the block itself must be
+    lane-aligned). Small-block test configs route to the gather+dense
+    fallback."""
+    return (
+        jax.default_backend() == "tpu"
+        and block_size % 128 == 0
+        and block_size >= 128
+        and D % 64 == 0
+    )
+
+
+def decode_attention_blocks_auto(q, k_pool, v_pool, block_tables, lengths,
+                                 mask):
+    """Paged decode-step router: the block-table Pallas kernel when
+    shapes/backend allow, gather-through-the-table + dense jnp over
+    ``mask`` otherwise. The flash branch never reads ``mask`` (XLA
+    dead-code-eliminates its construction); ``lengths`` and ``mask``
+    must describe the same live set, per decode_attention_auto."""
+    if q.shape[1] == 1 and decode_blocks_available(
+        k_pool.shape[1], q.shape[3]
+    ):
+        return decode_attention_blocks(
+            q, k_pool, v_pool, block_tables, lengths
+        )
+    return dense_attention(
+        q,
+        gather_block_kv(k_pool, block_tables),
+        gather_block_kv(v_pool, block_tables),
+        mask,
+    )
+
+
 # --- backward (recompute-based custom_vjp over the ragged kernel) ----------
 
 

@@ -1,0 +1,259 @@
+"""Host-side paged-KV bookkeeping: block pool refcounts + radix prefix
+cache over token ids.
+
+All decisions here happen on the host BETWEEN device steps — the jit'd
+admit/decode steps only ever see the static-shape i32 block tables this
+module hands them (the repo's no-data-dependent-control-flow-under-jit
+invariant). The device never allocates or frees; it scatters into blocks
+the host already committed.
+
+Design source: vLLM's PagedAttention block manager (Kwon et al. 2023)
+for the pool, SGLang's RadixAttention (Zheng et al. 2024) for the
+longest-prefix trie. No reference counterpart — the reference delegates
+the whole serving cache to the vLLM subprocess (vllm.go:93-112), so the
+paging policy is ours to own.
+
+Reference counting contract:
+- Block 0 is the reserved NULL block: never allocated, never freed.
+  Hosts pad dead table entries with it and retired slots' decode
+  scatters land in it (nondeterministic junk, read by nobody).
+- A slot admit holds one reference per block in its table (fresh blocks
+  arrive from alloc() with refcount 1; reused prefix blocks get a bump
+  from RadixCache.match). Retire drops them all.
+- The trie holds its own reference per cached node's block, so prefix
+  blocks survive slot retirement until LRU eviction needs the space.
+
+Lock order (outermost first): ContinuousEngine._lock ->
+RadixCache._lock -> BlockPool._lock. The trie calls into the pool under
+its own lock; nothing here calls back out.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from kubeinfer_tpu.analysis.racecheck import make_lock
+
+NULL_BLOCK = 0
+
+
+class BlockPool:
+    """Fixed-size pool of KV blocks with host-side refcounts.
+
+    Pure bookkeeping — the actual [num_blocks, block_size, n_kv, D]
+    device tensors live in the engine's SlotState; indices handed out
+    here are what the block tables (and the Pallas index_map) resolve.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"BlockPool needs >= 2 blocks (one is the reserved null "
+                f"block); got {num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._lock = make_lock("kv_blocks.BlockPool._lock")
+        # LIFO free list: recently-freed blocks are re-issued first,
+        # which keeps the working set of physical blocks small (warmer
+        # in whatever cache hierarchy the backend has).
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._ref = [0] * self.num_blocks
+
+    def alloc(self, n: int) -> list[int]:
+        """Take n blocks at refcount 1. Raises when the pool cannot
+        supply them — callers gate on free_blocks (or
+        RadixCache.ensure_free) first, so hitting this is a logic bug,
+        not backpressure."""
+        with self._lock:
+            if n > len(self._free):
+                raise RuntimeError(
+                    f"BlockPool exhausted: need {n}, have "
+                    f"{len(self._free)} free of {self.num_blocks}"
+                )
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def ref(self, blocks: Iterable[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise RuntimeError(f"ref of free block {b}")
+                self._ref[b] += 1
+
+    def unref(self, blocks: Iterable[int]) -> int:
+        """Drop one reference per block; blocks reaching 0 return to
+        the free list. Returns how many were freed."""
+        freed = 0
+        with self._lock:
+            for b in blocks:
+                if b == NULL_BLOCK:
+                    raise RuntimeError("unref of the null block")
+                if self._ref[b] <= 0:
+                    raise RuntimeError(f"unref of free block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+                    freed += 1
+        return freed
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref[block]
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        # excludes the null block: it is neither free nor usable
+        with self._lock:
+            return self.num_blocks - 1 - len(self._free)
+
+
+class _Node:
+    """One trie edge = one full block of tokens. The node holds the
+    pool block storing that span's KV (trie's own +1 reference)."""
+
+    __slots__ = ("children", "parent", "key", "block", "stamp")
+
+    def __init__(self, parent: "_Node | None", key: tuple | None,
+                 block: int) -> None:
+        self.children: dict[tuple, _Node] = {}
+        self.parent = parent
+        self.key = key
+        self.block = block
+        self.stamp = 0
+
+
+class RadixCache:
+    """Longest-prefix KV reuse over full blocks.
+
+    Keys are ``block_size``-token tuples, so a lookup walks at most
+    len(prompt) // block_size edges and the matched depth is always a
+    whole number of blocks — the partial tail block is never shared
+    (copy-on-write by construction: the admit path recomputes the tail
+    into a fresh block instead of appending to a shared one).
+
+    Eviction is LRU over leaves whose block nobody else references
+    (pool refcount == 1, i.e. only the trie's own hold) — an interior
+    node can only be evicted after its children, which preserves the
+    invariant that every cached path is fully materialized.
+    """
+
+    def __init__(self, pool: BlockPool) -> None:
+        self._pool = pool
+        self._lock = make_lock("kv_blocks.RadixCache._lock")
+        self._root = _Node(None, None, NULL_BLOCK)
+        self._clock = 0  # monotonic LRU stamp; touched on every match
+        self._nodes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _keys(self, tokens: Sequence[int]) -> list[tuple]:
+        bs = self._pool.block_size
+        return [
+            tuple(tokens[i: i + bs])
+            for i in range(0, len(tokens) - bs + 1, bs)
+        ]
+
+    def match(self, tokens: Sequence[int]) -> list[int]:
+        """Longest-prefix match in full blocks. Returns the matched
+        block ids in sequence order, each with one reference taken for
+        the caller (so eviction cannot free them between this call and
+        the admit that consumes them). The caller must unref any it
+        decides not to use."""
+        with self._lock:
+            self._clock += 1
+            out: list[int] = []
+            node = self._root
+            for key in self._keys(tokens):
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.stamp = self._clock
+                out.append(child.block)
+                node = child
+            self._pool.ref(out)
+            return out
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+        """Cache the full blocks of ``tokens``: blocks[i] holds tokens
+        [i*bs, (i+1)*bs). Existing nodes keep their block (the caller's
+        table already names them — match() handed them out); each NEW
+        node takes the trie's own reference on the caller's block.
+        Returns how many new nodes were created."""
+        created = 0
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for key, block in zip(self._keys(tokens), blocks):
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(node, key, block)
+                    node.children[key] = child
+                    self._pool.ref([block])
+                    self._nodes += 1
+                    created += 1
+                child.stamp = self._clock
+                node = child
+        return created
+
+    def note_result(self, reused_blocks: int) -> None:
+        """Record one admit's outcome for the hit/miss counters: a hit
+        is an admit that actually reused >= 1 block (after the engine's
+        capacity clamp), not merely one that matched."""
+        with self._lock:
+            if reused_blocks > 0:
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def _evict_one(self) -> bool:
+        # LRU scan over evictable leaves. O(nodes), fine at serving
+        # scale (thousands of nodes); called only when the pool is
+        # actually short.
+        victim: _Node | None = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (
+                node is not self._root
+                and not node.children
+                and self._pool.refcount(node.block) == 1
+                and (victim is None or node.stamp < victim.stamp)
+            ):
+                victim = node
+        if victim is None:
+            return False
+        assert victim.parent is not None
+        del victim.parent.children[victim.key]
+        self._pool.unref([victim.block])
+        self._nodes -= 1
+        self.evictions += 1
+        return True
+
+    def ensure_free(self, n: int) -> bool:
+        """Evict LRU-first until the pool has n free blocks. False when
+        eviction cannot get there (everything live is pinned by slots)
+        — the engine treats that as admission backpressure."""
+        with self._lock:
+            while self._pool.free_blocks < n:
+                if not self._evict_one():
+                    return False
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "nodes": self._nodes,
+            }

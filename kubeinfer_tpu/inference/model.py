@@ -214,8 +214,19 @@ def decoder_layer(
     attn_fn=attention,
     tp_axis: str | None = None,
     tp_size: int = 1,
+    block_tables: jax.Array | None = None,  # i32[B, max_blocks] paged write
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """One pre-norm block; returns (x, updated kv cache or None).
+
+    ``block_tables`` switches the cache write to the paged layout: the
+    cache operands are then the POOL tensors [num_blocks, block_size,
+    n_kv, D] shared across rows, and row b's token at logical position
+    ``cache_offset[b]`` lands in block ``block_tables[b, off // bs]``
+    at slot ``off % bs``. Decode-only (T == 1 with per-row offsets) —
+    prefill into the pool goes through the engine's gather/scatter
+    admit step, not through here. The paired ``attn_fn`` must read the
+    pool through the same tables (batching wires
+    decode_attention_blocks_auto).
 
     ``tp_axis``/``tp_size`` run the block in MANUAL tensor parallelism
     (inside a shard_map with Megatron-sharded weights,
@@ -248,7 +259,24 @@ def decoder_layer(
 
     if kv_cache is not None:
         ck, cv = kv_cache
-        if getattr(cache_offset, "ndim", 0) == 1:
+        if block_tables is not None:
+            if T != 1 or getattr(cache_offset, "ndim", 0) != 1:
+                raise ValueError(
+                    "block_tables requires T == 1 decode with per-row "
+                    "cache_offset (prefill writes go through the "
+                    "engine's paged admit, not decoder_layer)"
+                )
+            # paged decode write: one batched scatter into the pool.
+            # Rows of a retired slot carry an all-null table, so their
+            # write lands in the sacrificial block 0 — duplicate
+            # indices there make block 0's content nondeterministic,
+            # which is fine because nothing ever attends to it.
+            bs = ck.shape[1]
+            rows = jnp.arange(block_tables.shape[0])
+            blk = block_tables[rows, cache_offset // bs]
+            ck = ck.at[blk, cache_offset % bs].set(k[:, 0])
+            cv = cv.at[blk, cache_offset % bs].set(v[:, 0])
+        elif getattr(cache_offset, "ndim", 0) == 1:
             # per-row offsets (continuous-batching / ragged decode:
             # rows at different sequence positions in one dispatch)
             if T == 1:
@@ -327,6 +355,7 @@ def forward(
     tp_axis: str | None = None,
     tp_size: int = 1,
     return_hidden: bool = False,
+    block_tables: jax.Array | None = None,  # i32[B, max_blocks] paged write
 ) -> tuple[jax.Array, list | None]:
     """Logits [B, T, V] (+ updated KV caches when provided).
 
@@ -397,7 +426,7 @@ def forward(
         x, cache = decoder_layer(
             layer, x, cos, sin, attn_mask, cfg,
             kv_cache=cache, cache_offset=cache_offset, attn_fn=attn_fn,
-            tp_axis=tp_axis, tp_size=tp_size,
+            tp_axis=tp_axis, tp_size=tp_size, block_tables=block_tables,
         )
         if new_caches is not None:
             new_caches.append(cache)

@@ -44,6 +44,7 @@ import time
 from http.server import ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.metrics.registry import (
     Counter, Gauge, Histogram, Registry,
 )
@@ -122,6 +123,35 @@ def _serving_metrics(registry: Registry):
             "Draft tokens accepted by the target across all groups",
             registry=registry,
         ),
+        # paged-KV pool + radix prefix cache (batching.kv_cache_stats):
+        # gauges snapshot pool occupancy; the cache counters are
+        # Prometheus counters fed by delta at scrape time so restarts
+        # of the batcher never make them go backwards mid-series
+        "kv_blocks_in_use": Gauge(
+            "kubeinfer_kv_blocks_in_use",
+            "KV pool blocks referenced by live slots or the prefix cache",
+            registry=registry,
+        ),
+        "kv_blocks_free": Gauge(
+            "kubeinfer_kv_blocks_free",
+            "KV pool blocks on the free list",
+            registry=registry,
+        ),
+        "prefix_hits": Counter(
+            "kubeinfer_prefix_cache_hits_total",
+            "Admits that reused >= 1 cached prefix block",
+            registry=registry,
+        ),
+        "prefix_misses": Counter(
+            "kubeinfer_prefix_cache_misses_total",
+            "Admits that prefilled from token 0",
+            registry=registry,
+        ),
+        "prefix_evictions": Counter(
+            "kubeinfer_prefix_cache_evictions_total",
+            "Radix-cache nodes evicted (LRU) to free pool blocks",
+            registry=registry,
+        ),
     }
 
 
@@ -138,6 +168,11 @@ class InferenceServer:
         self.tokenizer = tokenizer
         self.registry = Registry()
         self.metrics = _serving_metrics(self.registry)
+        # last-seen monotonic kv_cache_stats counters, for the
+        # delta-to-Counter conversion at scrape time; guarded because
+        # ThreadingHTTPServer can run concurrent /metrics scrapes
+        self._kv_last: dict[str, int] = {}
+        self._kv_lock = make_lock("server.InferenceServer._kv_lock")
         server = self
 
         class Handler(BaseEndpointHandler):
@@ -250,12 +285,31 @@ class InferenceServer:
         return self.tokenizer.decode(ids)
 
     def _refresh_spec_metrics(self) -> None:
-        """Scrape-time refresh of the speculation gauges from the
-        batcher's counters (they mutate in the scheduler thread; gauges
-        snapshot rather than double-count)."""
-        if self.continuous is not None:
-            self.metrics["spec_served"].set(self.continuous.spec_served)
-            self.metrics["spec_accepted"].set(self.continuous.spec_accepted)
+        """Scrape-time refresh of the speculation gauges and the
+        paged-KV collectors from the batcher's counters (they mutate in
+        the scheduler thread; gauges snapshot rather than double-count,
+        and the monotonic radix counters convert to Prometheus counters
+        by delta under _kv_lock so concurrent scrapes never double-add)."""
+        if self.continuous is None:
+            return
+        self.metrics["spec_served"].set(self.continuous.spec_served)
+        self.metrics["spec_accepted"].set(self.continuous.spec_accepted)
+        stats = self.continuous.kv_cache_stats()
+        self.metrics["kv_blocks_in_use"].set(stats["blocks_in_use"])
+        self.metrics["kv_blocks_free"].set(stats["blocks_free"])
+        with self._kv_lock:
+            for key, name in (
+                ("hits", "prefix_hits"),
+                ("misses", "prefix_misses"),
+                ("evictions", "prefix_evictions"),
+            ):
+                delta = stats[key] - self._kv_last.get(key, 0)
+                # unconditional inc: a zero delta still materializes
+                # the sample, so the series exists (at 0) from the
+                # first scrape rather than popping into existence on
+                # its first event
+                self.metrics[name].inc(by=delta)
+                self._kv_last[key] = stats[key]
 
     def complete(self, body: dict) -> dict:
         # mutable holder: _complete records the chosen route the moment

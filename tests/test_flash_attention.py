@@ -579,3 +579,169 @@ class TestDecodeKernel:
             eng_mod._generate_jit._clear_cache()  # drop patched traces
         np.testing.assert_array_equal(got.tokens, ref.tokens)
         np.testing.assert_array_equal(got.lengths, ref.lengths)
+
+
+class TestBlockDecodeKernel:
+    """Block-table decode (paged KV) vs its jnp twin: BIT-identical per
+    the kernel/twin invariant — both resolve every KV tile through the
+    same scalar-prefetched block table and fold with _fold_tile_math.
+    Pools are junk-filled outside the scattered logical blocks and the
+    tables deliberately non-contiguous, so any read that escapes the
+    table (or depends on dead table entries) breaks parity loudly."""
+
+    def _paged(self, key, B, max_blocks, block_size, n_heads, n_kv, D,
+               lens, dtype=jnp.float32, extra_blocks=3):
+        """Scatter a logical [B, S] KV into a junk-initialised pool at
+        permuted (non-contiguous, interleaved-across-rows) block ids.
+        Returns the paged operands plus the gathered dense KV."""
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        S = max_blocks * block_size
+        q, k, v = _rand(key, B, 1, S, n_heads, n_kv, D, dtype)
+        num_blocks = 1 + B * max_blocks + extra_blocks
+        jk, jv = jax.random.split(jax.random.fold_in(key, 7))
+        kp = jax.random.normal(
+            jk, (num_blocks, block_size, n_kv, D)
+        ).astype(dtype)
+        vp = jax.random.normal(
+            jv, (num_blocks, block_size, n_kv, D)
+        ).astype(dtype)
+        rng = np.random.default_rng(17)
+        perm = rng.permutation(np.arange(1, num_blocks))
+        tables = perm[: B * max_blocks].reshape(B, max_blocks)
+        tables = np.ascontiguousarray(tables, np.int32)
+        kp = kp.at[tables.reshape(-1)].set(
+            k.reshape(B * max_blocks, block_size, n_kv, D)
+        )
+        vp = vp.at[tables.reshape(-1)].set(
+            v.reshape(B * max_blocks, block_size, n_kv, D)
+        )
+        # dead entries (beyond each row's live blocks) point at the
+        # null block, as the engine pads them — output must not care
+        lens = np.asarray(lens, np.int64)
+        for b in range(B):
+            live = -(-int(lens[b]) // block_size)
+            tables[b, live:] = 0
+        tables = jnp.asarray(tables)
+        lengths = jnp.asarray(lens, jnp.int32)
+        kg = fa.gather_block_kv(kp, tables)
+        vg = fa.gather_block_kv(vp, tables)
+        return q, kp, vp, tables, lengths, kg, vg
+
+    def _check(self, B, max_blocks, block_size, n_heads, n_kv, D, lens,
+               dtype=jnp.float32, dense_atol=2e-5, dense_rtol=1e-4):
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        q, kp, vp, tables, lengths, kg, vg = self._paged(
+            jax.random.PRNGKey(21), B, max_blocks, block_size, n_heads,
+            n_kv, D, lens, dtype,
+        )
+        got = fa.decode_attention_blocks(
+            q, kp, vp, tables, lengths, interpret=True
+        )
+        twin = fa.decode_attention_blocks_jnp(q, kp, vp, tables, lengths)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(twin),
+            err_msg="block kernel/twin bit-identity",
+        )
+        S = max_blocks * block_size
+        mask = jnp.broadcast_to(
+            jnp.arange(S)[None, None, :] < lengths[:, None, None],
+            (B, 1, S),
+        )
+        want = dense_attention(q, kg, vg, mask)
+        np.testing.assert_allclose(
+            np.asarray(twin, np.float32), np.asarray(want, np.float32),
+            atol=dense_atol, rtol=dense_rtol,
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (8, 2), (8, 1)])
+    def test_gqa_ratios_mixed_lengths(self, n_heads, n_kv):
+        # lengths straddle block boundaries: mid-block, exactly one
+        # block, full table, single token
+        self._check(4, 3, 16, n_heads, n_kv, 16, [17, 16, 48, 1])
+
+    @pytest.mark.slow
+    def test_bf16(self):
+        self._check(
+            3, 3, 16, 8, 2, 16, [5, 48, 33], dtype=jnp.bfloat16,
+            dense_atol=3e-2, dense_rtol=1e-1,
+        )
+
+    def test_zero_length_rows(self):
+        # retired-slot rows (length 0, table all null) must stay dense
+        # over the junk they point at — defined output, never NaN —
+        # alongside live rows
+        self._check(3, 2, 16, 4, 2, 8, [0, 32, 0])
+
+    def test_twin_matches_linear_twin(self):
+        # the block twin over a gathered-contiguous pool must equal the
+        # linear decode twin with tile_s == block_size bit-for-bit:
+        # same tile sweep, same fold math, only the addressing differs
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        q, kp, vp, tables, lengths, kg, vg = self._paged(
+            jax.random.PRNGKey(22), 3, 3, 16, 8, 2, 16, [17, 48, 0]
+        )
+        twin = fa.decode_attention_blocks_jnp(q, kp, vp, tables, lengths)
+        linear = fa.decode_attention_jnp(q, kg, vg, lengths, tile_s=16)
+        np.testing.assert_array_equal(
+            np.asarray(twin), np.asarray(linear),
+            err_msg="block twin vs linear twin bit-identity",
+        )
+
+    def test_shared_prefix_blocks(self):
+        # radix reuse aliases one physical block into several rows'
+        # tables; the kernel only ever reads KV, so aliased tables must
+        # behave exactly like their gathered-dense expansion
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        B, bs, n_kv, D = 3, 16, 2, 8
+        q, _, _ = _rand(
+            jax.random.PRNGKey(23), B, 1, 2 * bs, 4, n_kv, D,
+            jnp.float32,
+        )
+        jk, jv = jax.random.split(jax.random.PRNGKey(24))
+        kp = jax.random.normal(jk, (6, bs, n_kv, D))
+        vp = jax.random.normal(jv, (6, bs, n_kv, D))
+        tables = jnp.asarray(
+            [[5, 2], [5, 4], [5, 1]], jnp.int32  # block 5 shared 3-ways
+        )
+        lengths = jnp.asarray([32, 20, 16], jnp.int32)
+        got = fa.decode_attention_blocks(
+            q, kp, vp, tables, lengths, interpret=True
+        )
+        twin = fa.decode_attention_blocks_jnp(q, kp, vp, tables, lengths)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(twin))
+        mask = jnp.broadcast_to(
+            jnp.arange(2 * bs)[None, None, :] < lengths[:, None, None],
+            (B, 1, 2 * bs),
+        )
+        want = dense_attention(
+            q, fa.gather_block_kv(kp, tables),
+            fa.gather_block_kv(vp, tables), mask,
+        )
+        np.testing.assert_allclose(
+            np.asarray(twin), np.asarray(want), atol=2e-5, rtol=1e-4
+        )
+
+    def test_auto_falls_back_off_tpu(self):
+        # CPU test env: blocks_auto must take the gathered dense path
+        import kubeinfer_tpu.inference.flash_attention as fa
+
+        q, kp, vp, tables, lengths, kg, vg = self._paged(
+            jax.random.PRNGKey(25), 2, 2, 16, 4, 2, 8, [9, 32]
+        )
+        mask = jnp.broadcast_to(
+            jnp.arange(32)[None, None, :] < lengths[:, None, None],
+            (2, 1, 32),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(
+                fa.decode_attention_blocks_auto(
+                    q, kp, vp, tables, lengths, mask
+                )
+            ),
+            np.asarray(dense_attention(q, kg, vg, mask)),
+        )
