@@ -635,10 +635,30 @@ def serving_trace_bench(n_requests=16, prompt_len=256, max_new=8,
             warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
             eng.generate(warm, max_new_tokens=max_new)
             _touch_progress()
+            # profiler cursor + clock bracket around the cold phase:
+            # goodput/occupancy publish from the SAME StepProfiler
+            # records /metrics serves (honesty contract of this
+            # section), windowed to the phase rather than the
+            # profiler's sliding default so the figure covers exactly
+            # the measured requests
+            prof = eng.profiler.snapshot()
+            prof_seq = prof[-1].seq if prof else -1
+            phase_t0 = tracing.now()
             cold_ttfts, waits = _measure([
                 rng.integers(0, cfg.vocab_size, prompt_len).tolist()
                 for _ in range(n_requests)
             ])
+            phase_s = max(tracing.now() - phase_t0, 1e-9)
+            steps = eng.profiler.snapshot(since_seq=prof_seq)
+            decode_steps = [r for r in steps if r.phase == "decode"]
+            goodput = sum(r.live_tokens for r in steps) / phase_s
+            occupancy = (
+                sum(r.occupancy() for r in decode_steps)
+                / len(decode_steps) if decode_steps else 0.0
+            )
+            padded = sum(r.padded_tokens for r in steps)
+            live = sum(r.live_tokens for r in steps)
+            padding_waste = padded / max(live + padded, 1)
 
             # WARM phase: all prompts = shared prefix + unique 8-token
             # tail. Two unmeasured requests first: the plant (a miss —
@@ -681,6 +701,9 @@ def serving_trace_bench(n_requests=16, prompt_len=256, max_new=8,
         "prefix_hit_rate": round(
             hit_delta / max(hit_delta + miss_delta, 1), 3
         ),
+        "goodput_tokens_per_sec": round(goodput, 3),
+        "batch_occupancy_b8": round(occupancy, 4),
+        "padding_waste_frac": round(padding_waste, 4),
     }
 
 
@@ -1066,6 +1089,9 @@ def main() -> None:
             extras["queue_wait_ms_p99"] = tr["queue_wait_ms_p99"]
             extras["ttft_ms_b8_prefix_hit"] = tr["ttft_ms_b8_prefix_hit"]
             extras["prefix_hit_rate"] = tr["prefix_hit_rate"]
+            extras["goodput_tokens_per_sec"] = tr["goodput_tokens_per_sec"]
+            extras["batch_occupancy_b8"] = tr["batch_occupancy_b8"]
+            extras["padding_waste_frac"] = tr["padding_waste_frac"]
         except Exception as e:
             extras["serving_trace_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)

@@ -388,6 +388,7 @@ class NodeAgent:
         start_runtimes: bool = False,
         lease_timings: tuple[float, float, float] | None = None,
         observe_memory=None,
+        serving_stats=None,
     ) -> None:
         self._store = store
         self.node_name = node_name
@@ -406,6 +407,12 @@ class NodeAgent:
         # tests). None disables observation: heartbeats report full
         # capacity as before.
         self._observe_memory = observe_memory
+        # () -> dict | None: serving-replica efficiency summary
+        # (ContinuousEngine.stats_summary-backed when this node runs a
+        # serving replica; injectable like observe_memory). Advertised
+        # on the NodeState heartbeat so the control plane sees replica
+        # load without scraping every pod's /metrics.
+        self._serving_stats = serving_stats
         # per-replica HBM demand for replicas THIS agent runs — the
         # framework-owned share of observed usage (see heartbeat)
         self._replica_mem: dict[tuple[str, str, int], int] = {}
@@ -464,6 +471,15 @@ class NodeAgent:
                 framework = sum(self._replica_mem.values())
                 external_used = max(0, (total_obs - free_obs) - framework)
                 mem_free = max(0, self._mem_capacity - external_used)
+        serving: dict = {}
+        if self._serving_stats is not None:
+            # a flaky stats callback must never cost the heartbeat —
+            # liveness signal beats load telemetry
+            try:
+                serving = self._serving_stats() or {}
+            except Exception:  # noqa: BLE001
+                log.exception("serving_stats callback failed; "
+                              "heartbeating without stats")
         state = NodeState(
             gpu_capacity=self._gpu_capacity,
             gpu_free=self._gpu_capacity,
@@ -473,6 +489,7 @@ class NodeAgent:
             cached_models=self._cached_models(),
             ready=True,
             heartbeat=self._clock.now(),
+            serving_stats=serving,
         )
         state.metadata.name = self.node_name
         d = state.to_dict()

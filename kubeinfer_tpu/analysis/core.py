@@ -58,6 +58,11 @@ RULES = {
         "(CLI entrypoints — __main__.py, ctl.py, bench.py, scripts/ — "
         "are exempt)"
     ),
+    "metric-name": (
+        "Counter/Gauge/Histogram whose literal name breaks the "
+        "kubeinfer_ prefix / unit-suffix convention (Counter: _total; "
+        "Histogram: _seconds/_bytes; Gauge: unit or quantity suffix)"
+    ),
     "lint-bare-allow": (
         "a `# lint: allow[rule]` without a reason string (reasons are "
         "mandatory; this finding is itself unsuppressable)"
@@ -188,7 +193,9 @@ def analyze_source(
     """
     # local imports: core is imported by racecheck users at runtime and
     # must not pay for the AST passes unless analysis actually runs
-    from kubeinfer_tpu.analysis import jitlint, lockcheck, logdiscipline
+    from kubeinfer_tpu.analysis import (
+        jitlint, lockcheck, logdiscipline, metricnames,
+    )
 
     if boundary is None:
         boundary = not _is_test_file(path)
@@ -208,6 +215,7 @@ def analyze_source(
                                 def_registry=local, boundary=boundary))
     findings.extend(lockcheck.run(tree, path))
     findings.extend(logdiscipline.run(tree, path))
+    findings.extend(metricnames.run(tree, path))
     sup = _collect_suppressions(source, path)
     findings = [f for f in findings if not sup.allows(f)]
     findings.extend(sup.meta_findings)

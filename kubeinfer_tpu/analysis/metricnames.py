@@ -1,0 +1,120 @@
+"""Metric-name convention AST pass (rule ``metric-name``).
+
+Prometheus names are the repo's public observability API: dashboards
+and alert rules key on them, and renames are silent breakage (the old
+series just stops). The convention the existing collector set follows
+(metrics/registry.py, inference/server.py) is enforced here so new
+collectors cannot drift:
+
+- every name matches ``kubeinfer_[a-z0-9_]+`` — one namespace, lower
+  snake case (the reference's metrics.go uses the same prefix);
+- ``Counter`` names end ``_total`` (Prometheus counter convention);
+- ``Histogram`` names carry a base unit suffix: ``_seconds`` or
+  ``_bytes``;
+- ``Gauge`` names carry a unit suffix (``_seconds``/``_bytes``/
+  ``_total``) or one of the unitless suffixes the repo's gauges
+  actually use (``_replicas``, ``_ratio``, ``_state``, ...) — a gauge
+  named ``kubeinfer_foo`` tells an operator nothing about what a value
+  of 3 means;
+- the name must be a literal string at the construction site: a
+  computed name cannot be greped for from an alert rule, so it defeats
+  the point of the convention.
+
+Kind detection is syntactic: a call whose callee is the bare name
+``Counter``/``Gauge``/``Histogram`` (the repo imports them unaliased
+from metrics.registry). ``collections.Counter(...)`` and other dotted
+calls are not matched. Test files are exempt (fixtures deliberately
+use short names like ``t_total``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kubeinfer_tpu.analysis.core import Finding, _is_test_file
+
+__all__ = ["run"]
+
+_NAME_RE = re.compile(r"^kubeinfer_[a-z0-9_]+$")
+
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+
+# Unitless-gauge vocabulary: suffixes that make the quantity
+# self-describing without a base unit. Extending this tuple is the
+# sanctioned way to introduce a new gauge family — the alternative
+# (an allow comment) hides the new suffix from this inventory.
+_GAUGE_SUFFIXES = _UNIT_SUFFIXES + (
+    "_total", "_replicas", "_ratio", "_size", "_state", "_requests",
+    "_drafts", "_in_use", "_free", "_frac", "_rate", "_remaining",
+    "_depth", "_occupancy", "_per_second",
+)
+
+_KINDS = ("Counter", "Gauge", "Histogram")
+
+
+def _check(kind: str, name: str) -> str | None:
+    """Return the violation message for ``kind`` named ``name``, or
+    None when compliant."""
+    if not _NAME_RE.match(name):
+        return (
+            f"{kind} name {name!r} must match kubeinfer_[a-z0-9_]+ "
+            "(single namespace, lower snake case)"
+        )
+    if kind == "Counter":
+        if not name.endswith("_total"):
+            return f"Counter name {name!r} must end with _total"
+    elif kind == "Histogram":
+        if not name.endswith(_UNIT_SUFFIXES):
+            return (
+                f"Histogram name {name!r} must end with a base unit "
+                "suffix (_seconds or _bytes)"
+            )
+    elif kind == "Gauge":
+        if not name.endswith(_GAUGE_SUFFIXES):
+            return (
+                f"Gauge name {name!r} needs a unit or quantity suffix "
+                "(one of: " + ", ".join(_GAUGE_SUFFIXES) + ")"
+            )
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in _KINDS:
+            kind = func.id
+            first = node.args[0] if node.args else None
+            if first is None:
+                name_kw = next(
+                    (k.value for k in node.keywords if k.arg == "name"),
+                    None,
+                )
+                first = name_kw
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                msg = _check(kind, first.value)
+                if msg is not None:
+                    self.findings.append(Finding(
+                        self.path, node.lineno, "metric-name", msg,
+                    ))
+            elif first is not None:
+                self.findings.append(Finding(
+                    self.path, node.lineno, "metric-name",
+                    f"{kind} name must be a literal string (computed "
+                    "names cannot be grepped from alert rules)",
+                ))
+        self.generic_visit(node)
+
+
+def run(tree: ast.AST, path: str) -> list[Finding]:
+    if _is_test_file(path):
+        return []
+    v = _Visitor(path)
+    v.visit(tree)
+    return v.findings

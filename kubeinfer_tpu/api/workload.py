@@ -153,6 +153,12 @@ class NodeState:
     ip: str = ""
     ready: bool = True
     heartbeat: float = 0.0
+    # Serving-replica efficiency summary advertised by the node's
+    # engine (batching.ContinuousEngine.stats_summary): occupancy,
+    # queue depth, goodput, free KV blocks, prefix hit rate. Opaque to
+    # the solver today — consumers are dashboards and future
+    # load-aware routing; empty when the node runs no serving replica.
+    serving_stats: dict = field(default_factory=dict)
 
     def deepcopy(self) -> "NodeState":
         return copy.deepcopy(self)
@@ -171,6 +177,7 @@ class NodeState:
             "ip": self.ip,
             "ready": self.ready,
             "heartbeat": self.heartbeat,
+            "servingStats": dict(self.serving_stats),
         }
 
     @classmethod
@@ -188,4 +195,5 @@ class NodeState:
             ip=d.get("ip", ""),
             ready=bool(d.get("ready", True)),
             heartbeat=float(d.get("heartbeat", 0.0)),
+            serving_stats=dict(d.get("servingStats") or {}),
         )

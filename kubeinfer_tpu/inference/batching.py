@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -45,9 +46,13 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
 from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
-from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.observability import tracing
+from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
+from kubeinfer_tpu.observability.stepprof import StepProfiler
+
+log = logging.getLogger(__name__)
 
 # spans are recorded retroactively from the request timeline below, so
 # the scheduler never holds a live span across passes (docs/OBSERVABILITY.md)
@@ -421,6 +426,18 @@ class ContinuousEngine:
             )
         self._pool = BlockPool(num_blocks, self.block_size)
         self._radix = RadixCache(self._pool)
+        # step-level observability (docs/OBSERVABILITY.md): one record
+        # per device dispatch, plus the scheduler-decision flight ring.
+        # The kv_stats callback reads the pool's own locked counters and
+        # runs OUTSIDE the profiler lock, so no cycle joins the
+        # engine -> radix -> pool order.
+        self.profiler = StepProfiler(
+            n_slots=n_slots,
+            kv_stats=lambda: (self._pool.used_blocks,
+                              self._pool.free_blocks),
+            name="batching.StepProfiler._lock",
+        )
+        self.flight = FlightRecorder(name="batching.FlightRecorder._lock")
         # host copy of each slot's owned block ids (shared + fresh), in
         # table order — what retire returns to the pool
         self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
@@ -493,6 +510,8 @@ class ContinuousEngine:
             tracing.new_root_context()
         req.t_submit = tracing.now()
         self._queue.put(req)
+        self._note("submit", prompt_tokens=len(prompt),
+                   max_new=max_new_tokens)
         return req
 
     def serve(self, prompt: list[int], max_new_tokens: int = 32,
@@ -535,6 +554,52 @@ class ContinuousEngine:
         stats["blocks_in_use"] = self._pool.used_blocks
         stats["blocks_free"] = self._pool.free_blocks
         return stats
+
+    def _note(self, kind: str, **detail) -> None:
+        """Flight-recorder entry with queue depth + pool occupancy
+        observed NOW. Callable from any thread: qsize and the pool
+        counters each take their own locks; the holdover is not folded
+        in (reading it here would need the engine lock from submit()'s
+        HTTP threads — queue_depth is a decision-time signal, not an
+        accounting invariant)."""
+        self.flight.note(
+            kind,
+            queue_depth=self._queue.qsize(),
+            kv_in_use=self._pool.used_blocks,
+            kv_free=self._pool.free_blocks,
+            **detail,
+        )
+
+    def stats_summary(self, window_s: float = 60.0) -> dict:
+        """One-dict replica serving summary for the node agent's
+        NodeState heartbeat (and /debug callers): occupancy, queue
+        depth, goodput, free blocks, prefix hit rate. Everything here
+        is advertised to the control-plane store, where ROADMAP item 4's
+        prefix-cache-aware router and the reconciler's cost tensor can
+        finally see per-replica load. Plain JSON-serializable scalars
+        only — NodeState.to_dict embeds it verbatim."""
+        prof = self.profiler.summary(window_s=window_s)
+        kv = self.kv_cache_stats()
+        # lockless holdover peek: the engine lock is held across admit
+        # jit compiles (potentially tens of seconds) and a heartbeat
+        # must never stall behind one; a torn read here only skews
+        # queue_depth by 1 for one sample
+        holdover = self._holdover is not None
+        lookups = kv["hits"] + kv["misses"]
+        return {
+            "n_slots": self.n_slots,
+            "queue_depth": self._queue.qsize() + (1 if holdover else 0),
+            "batch_occupancy": round(prof["batch_occupancy"], 6),
+            "goodput_tokens_per_sec": round(
+                prof["goodput_tokens_per_sec"], 6
+            ),
+            "padding_waste_frac": round(prof["padding_waste_frac"], 6),
+            "kv_blocks_free": kv["blocks_free"],
+            "kv_blocks_in_use": kv["blocks_in_use"],
+            "prefix_hit_rate": round(
+                kv["hits"] / lookups if lookups else 0.0, 6
+            ),
+        }
 
     def prewarm_spec(self, group_sizes: tuple[int, ...] = (1,),
                      prompt_len: int = 8, max_new_tokens: int = 8,
@@ -600,6 +665,7 @@ class ContinuousEngine:
         """Fail over every published in-flight request (slots, live
         group, holdover) — shared by stop() and the scheduler loop's
         epilogue; all handoff fields are swapped under the lock."""
+        failed = 0
         with self._lock:
             holdover, self._holdover = self._holdover, None
             group, self._spec_group = self._spec_group, None
@@ -608,13 +674,28 @@ class ContinuousEngine:
                     self._slot_req[slot] = None
                     req.failed = "engine stopped mid-generation"
                     req.done.set()
+                    failed += 1
         if holdover is not None:
             holdover.failed = "engine stopped before the request was served"
             holdover.done.set()
+            failed += 1
         if group is not None:
             for req in group[0]:
                 req.failed = "engine stopped mid-generation"
                 req.done.set()
+                failed += 1
+        if failed:
+            # auto-dump the flight recorder: the post-mortem needs the
+            # scheduler's last decisions in the log stream even if the
+            # process dies before anyone curls /debug/flightrecorder.
+            # Guarded on failed>0 so the stop()+epilogue double
+            # invocation dumps at most once (the second sweep finds
+            # nothing published).
+            self._note("fail_inflight", failed=failed)
+            log.warning(
+                "engine stopped with %d in-flight request(s); "
+                "flight recorder dump:\n%s", failed, self.flight.render(),
+            )
 
     # -- scheduler loop ---------------------------------------------------
 
@@ -644,10 +725,16 @@ class ContinuousEngine:
             self._pool.unref(matched[reuse:])
         shared = matched[:reuse]
         total = -(-(p + req.max_new) // bs)  # ceil; fits() bounds it
+        ev_before = self._radix.stats()["evictions"]
         if not self._radix.ensure_free(total - reuse):
             if shared:
                 self._pool.unref(shared)
+            self._note("backpressure", prompt_tokens=p,
+                       need_blocks=total - reuse)
             return None
+        evicted = self._radix.stats()["evictions"] - ev_before
+        if evicted:
+            self._note("evict", nodes=evicted, need_blocks=total - reuse)
         fresh = self._pool.alloc(total - reuse)
         self._radix.note_result(reuse)
         table_row = np.zeros(self.max_blocks, np.int32)
@@ -703,6 +790,19 @@ class ContinuousEngine:
         req.out_tokens.append(first)
         req.t_first = tracing.now()
         req.token_times.append(req.t_first)
+        # one profiler record per prefill dispatch: t_admit -> t_first
+        # brackets the _admit_slot call + its host sync above. The
+        # prefill's one live token is the sampled first token; the
+        # padding waste is the bucket tail (T - suffix_len) the static
+        # shapes force us to compute.
+        live_rows = sum(1 for r in self._slot_req if r is not None)
+        self.profiler.record(
+            "prefill", bucket=T, live_rows=live_rows,
+            live_tokens=suffix_len, padded_tokens=T - suffix_len,
+            start=req.t_admit, end=req.t_first,
+        )
+        self._note("admit", slot=slot, suffix_bucket=T,
+                   reuse_blocks=reuse, total_blocks=total)
         sp = _TRACER.start_span(
             "engine.prefill", parent=req.trace_parent, start=req.t_admit,
             slot=slot, prompt_tokens=len(req.prompt), bucket=T,
@@ -740,6 +840,9 @@ class ContinuousEngine:
                 tables=self._state.tables.at[slot].set(0),
             )
             req.t_done = tracing.now()
+            self._note("retire", slot=slot, tokens=len(req.out_tokens),
+                       freed_blocks=len(blocks),
+                       cancelled=req.cancelled.is_set())
             sp = _TRACER.start_span(
                 "engine.decode", parent=req.trace_parent,
                 start=req.t_first or req.t_done, slot=slot,
@@ -854,6 +957,7 @@ class ContinuousEngine:
             for r in reqs:
                 r.done.set()
             return
+        spec_t0 = tracing.now()
         try:
             done = self.speculative.step_group(g)
             out = self.speculative.finish_group(g) if done else None
@@ -865,6 +969,20 @@ class ContinuousEngine:
                 r.failed = f"speculative decode failed: {e}"
                 r.done.set()
             return
+        # group tokens only become countable when the group finishes
+        # (finish_group copies the accepted rows out); intermediate
+        # rounds record zero live tokens but still carry the dispatch
+        # duration, so the step histogram sees every device round
+        emitted = (
+            sum(min(int(out.lengths[b]), r.max_new)
+                for b, r in enumerate(reqs))
+            if out is not None else 0
+        )
+        self.profiler.record(
+            "spec", bucket=len(reqs), live_rows=len(reqs),
+            live_tokens=emitted, padded_tokens=0,
+            start=spec_t0, end=tracing.now(),
+        )
         if out is None:
             return
         with self._lock:
@@ -958,10 +1076,11 @@ class ContinuousEngine:
             # round per loop pass), so neither starves the other
             self._admit_pending()
             with self._lock:
-                busy = any(r is not None for r in self._slot_req)
-            if busy:
+                live_rows = sum(1 for r in self._slot_req if r is not None)
+            if live_rows:
                 # device step outside the lock (it can block on a
                 # compile; stop() must still be able to fail the slots)
+                step_t0 = tracing.now()
                 # lint: allow[lock-discipline] scheduler thread is the only _state writer; see comment above
                 self._state, tokens = _decode_step(
                     self.params, self._state, self.cfg
@@ -971,6 +1090,14 @@ class ContinuousEngine:
                 # one clock read per device step, outside the lock: all
                 # tokens of a step share its arrival time
                 step_t = tracing.now()
+                # decode dispatch is always the full n_slots-wide batch
+                # (static shapes): inactive rows are pure padding
+                self.profiler.record(
+                    "decode", bucket=self.n_slots, live_rows=live_rows,
+                    live_tokens=live_rows,
+                    padded_tokens=self.n_slots - live_rows,
+                    start=step_t0, end=step_t,
+                )
                 with self._lock:
                     for slot in range(self.n_slots):
                         req = self._slot_req[slot]

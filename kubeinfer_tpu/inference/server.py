@@ -49,7 +49,8 @@ from kubeinfer_tpu.metrics.registry import (
     Counter, Gauge, Histogram, Registry,
 )
 from kubeinfer_tpu.observability import tracing
-from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler
+from kubeinfer_tpu.observability.slo import SLOMonitor
+from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, token_matches
 
 log = logging.getLogger(__name__)
 
@@ -152,6 +153,58 @@ def _serving_metrics(registry: Registry):
             "Radix-cache nodes evicted (LRU) to free pool blocks",
             registry=registry,
         ),
+        # step-level engine efficiency (batching.StepProfiler): goodput
+        # separates "tokens the device produced for someone" from the
+        # padded work static shapes force; occupancy/padding-waste say
+        # WHY goodput moved (empty slots vs bucket padding). Gauges
+        # snapshot the profiler's sliding-window summary at scrape time.
+        "goodput": Gauge(
+            "kubeinfer_engine_goodput_tokens_per_second",
+            "Live (non-padding) tokens produced per second, sliding "
+            "window over profiler steps",
+            registry=registry,
+        ),
+        "occupancy": Gauge(
+            "kubeinfer_engine_batch_occupancy",
+            "Mean live-rows / n_slots over recent decode dispatches",
+            registry=registry,
+        ),
+        "padding_waste": Gauge(
+            "kubeinfer_engine_padding_waste_frac",
+            "Padded / (live + padded) tokens over recent dispatches",
+            registry=registry,
+        ),
+        "queue_depth": Gauge(
+            "kubeinfer_engine_queue_depth",
+            "Requests waiting for a slot (submit queue + holdover)",
+            registry=registry,
+        ),
+        "step_duration": Histogram(
+            "kubeinfer_engine_step_duration_seconds",
+            "Device dispatch wall time by phase (prefill/decode/spec)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
+            labels=("phase",), registry=registry,
+        ),
+        "compiles": Counter(
+            "kubeinfer_engine_compiles_total",
+            "Device dispatches that hit a first-seen (phase, bucket) "
+            "shape (jit compile proxy)",
+            registry=registry,
+        ),
+        # SLO burn rates (observability/slo.py): burn 1.0 = spending
+        # budget exactly at the sustainable rate; the window label keeps
+        # the short/long pair an alerting rule needs in one series
+        "slo_burn": Gauge(
+            "kubeinfer_slo_burn_rate",
+            "Error-budget burn rate per objective and window",
+            labels=("slo", "window"), registry=registry,
+        ),
+        "slo_budget": Gauge(
+            "kubeinfer_slo_budget_remaining",
+            "Signed remaining budget fraction over the longest window",
+            labels=("slo",), registry=registry,
+        ),
     }
 
 
@@ -159,25 +212,46 @@ class InferenceServer:
     def __init__(self, engine, model_id: str, tokenizer=None,
                  host: str = "127.0.0.1", port: int = 8000,
                  continuous=None, speculative=None, sp=None,
-                 tls_cert: str = "", tls_key: str = "") -> None:
+                 tls_cert: str = "", tls_key: str = "",
+                 token: str = "", slo=None) -> None:
         self.engine = engine
         self.continuous = continuous  # ContinuousEngine | None
         self.speculative = speculative  # SpeculativeEngine | None
         self.sp = sp  # SPEngine | None (sequence-parallel long prompts)
         self.model_id = model_id
         self.tokenizer = tokenizer
+        # bearer token guarding /debug/* only: traces and flight
+        # recorder dumps carry prompt lengths and scheduling detail,
+        # /metrics stays open like every scrape target. Empty = open
+        # (tests, pod-network-only deployments) — same contract as the
+        # store's debug endpoints (httpstore.py).
+        self._token = token
+        self.slo = slo if slo is not None else SLOMonitor()
         self.registry = Registry()
         self.metrics = _serving_metrics(self.registry)
         # last-seen monotonic kv_cache_stats counters, for the
         # delta-to-Counter conversion at scrape time; guarded because
         # ThreadingHTTPServer can run concurrent /metrics scrapes
         self._kv_last: dict[str, int] = {}
+        # profiler replay cursor: each step record feeds the duration
+        # histogram exactly once across concurrent scrapes
+        self._prof_seq = -1
         self._kv_lock = make_lock("server.InferenceServer._kv_lock")
         server = self
 
         class Handler(BaseEndpointHandler):
+            def _authed(self) -> bool:
+                if not server._token:
+                    return True
+                got = self.headers.get("Authorization", "")
+                return token_matches(got, server._token)
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
+                if path.startswith("/debug/") and not self._authed():
+                    self.respond(401, "application/json",
+                                 json.dumps({"error": "unauthorized"}))
+                    return
                 if path == "/health":
                     self.respond(200, "text/plain", "OK")
                 elif path == "/metrics":
@@ -202,12 +276,27 @@ class InferenceServer:
                     # recorded spans as Chrome trace-event JSON —
                     # save the body and open it in Perfetto
                     # (docs/OBSERVABILITY.md); ?trace_id= narrows to
-                    # one request's trace
+                    # one request's trace. Engine counter tracks
+                    # (occupancy / queue depth / kv blocks) merge in as
+                    # their own process group so the curves render next
+                    # to the span timeline.
                     q = parse_qs(urlparse(self.path).query)
                     tid = (q.get("trace_id") or [None])[0]
+                    doc = tracing.RECORDER.to_chrome_trace(tid)
+                    server._merge_counter_tracks(doc)
+                    self.respond(
+                        200, "application/json", json.dumps(doc),
+                    )
+                elif path == "/debug/flightrecorder":
+                    fl = (server.continuous.flight.to_dict()
+                          if server.continuous is not None
+                          else {"capacity": 0, "recorded": 0,
+                                "events": []})
+                    self.respond(200, "application/json", json.dumps(fl))
+                elif path == "/debug/slo":
                     self.respond(
                         200, "application/json",
-                        json.dumps(tracing.RECORDER.to_chrome_trace(tid)),
+                        json.dumps(server.slo.snapshot()),
                     )
                 else:
                     self.respond(404, "text/plain", "not found\n")
@@ -289,7 +378,15 @@ class InferenceServer:
         paged-KV collectors from the batcher's counters (they mutate in
         the scheduler thread; gauges snapshot rather than double-count,
         and the monotonic radix counters convert to Prometheus counters
-        by delta under _kv_lock so concurrent scrapes never double-add)."""
+        by delta under _kv_lock so concurrent scrapes never double-add).
+        SLO gauges refresh even without a continuous engine — every
+        route feeds _observe_breakdown, so the burn rates are
+        meaningful for per-request/speculative-only servers too."""
+        snap = self.slo.snapshot()
+        for name, obj in snap["objectives"].items():
+            for w, d in obj["windows"].items():
+                self.metrics["slo_burn"].set(name, f"{w}s", d["burn_rate"])
+            self.metrics["slo_budget"].set(name, obj["budget_remaining"])
         if self.continuous is None:
             return
         self.metrics["spec_served"].set(self.continuous.spec_served)
@@ -297,6 +394,11 @@ class InferenceServer:
         stats = self.continuous.kv_cache_stats()
         self.metrics["kv_blocks_in_use"].set(stats["blocks_in_use"])
         self.metrics["kv_blocks_free"].set(stats["blocks_free"])
+        summary = self.continuous.stats_summary()
+        self.metrics["goodput"].set(summary["goodput_tokens_per_sec"])
+        self.metrics["occupancy"].set(summary["batch_occupancy"])
+        self.metrics["padding_waste"].set(summary["padding_waste_frac"])
+        self.metrics["queue_depth"].set(summary["queue_depth"])
         with self._kv_lock:
             for key, name in (
                 ("hits", "prefix_hits"),
@@ -310,6 +412,37 @@ class InferenceServer:
                 # its first event
                 self.metrics[name].inc(by=delta)
                 self._kv_last[key] = stats[key]
+            # profiler replay under the same lock: the cursor advance
+            # and the histogram observes must be atomic per scrape or a
+            # concurrent scrape double-counts the same step records
+            self.metrics["compiles"].inc(by=0)
+            recs = self.continuous.profiler.snapshot(
+                since_seq=self._prof_seq
+            )
+            for r in recs:
+                self.metrics["step_duration"].observe(r.phase, r.dur_s)
+                if r.compiled:
+                    self.metrics["compiles"].inc()
+            if recs:
+                self._prof_seq = recs[-1].seq
+
+    def _merge_counter_tracks(self, doc: dict) -> None:
+        """Append the engine's counter tracks (batch occupancy, padded
+        tokens, queue depth, kv blocks) to a Chrome trace doc as one
+        extra process group, so Perfetto shows the efficiency curves
+        under the span timeline they explain. No-op without a
+        continuous engine."""
+        if self.continuous is None:
+            return
+        events = doc.get("traceEvents", [])
+        pid = max((e.get("pid", 0) for e in events), default=0) + 1
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "engine-counters"},
+        })
+        events.extend(self.continuous.profiler.counter_events(pid))
+        events.extend(self.continuous.flight.counter_events(pid))
+        doc["traceEvents"] = events
 
     def complete(self, body: dict) -> dict:
         # mutable holder: _complete records the chosen route the moment
@@ -358,9 +491,9 @@ class InferenceServer:
         decode_s = None
         if req is not None and req.t_submit:
             if req.t_admit:
-                self.metrics["queue_wait"].observe(
-                    route, max(0.0, req.t_admit - req.t_submit)
-                )
+                wait = max(0.0, req.t_admit - req.t_submit)
+                self.metrics["queue_wait"].observe(route, wait)
+                self.slo.observe("queue_wait", wait)
             end = req.t_done or req.t_submit + total_s
             if req.t_first:
                 ttft = max(0.0, req.t_first - req.t_submit)
@@ -368,11 +501,13 @@ class InferenceServer:
             else:  # draft-group path: no per-token timeline
                 ttft = max(0.0, end - req.t_submit)
         self.metrics["ttft"].observe(route, ttft)
+        self.slo.observe("ttft", ttft)
         if decode_s is not None and n_out > 1:
             tpot = decode_s / (n_out - 1)
         else:
             tpot = total_s / max(1, n_out)
         self.metrics["tpot"].observe(route, tpot)
+        self.slo.observe("tpot", tpot)
 
     def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")
@@ -572,6 +707,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="serve completions over TLS (PEM cert; key via "
                         "--tls-key-file)")
     p.add_argument("--tls-key-file", default="")
+    p.add_argument("--debug-token-file", default="",
+                   help="file holding the bearer token required on "
+                        "/debug/* (spans, flight recorder, SLO); empty "
+                        "leaves them open")
+    p.add_argument("--slo", action="append", default=[],
+                   metavar="NAME:THRESHOLD_S:OBJECTIVE",
+                   help="SLO objective, repeatable (e.g. ttft:0.5:0.99 "
+                        "= 99%% of requests see first token in 500ms); "
+                        "names: ttft, tpot, queue_wait. Default: loose "
+                        "built-ins (observability/slo.py)")
     args = p.parse_args(argv)
     # lint: allow[log-discipline] main() is the process entrypoint and owns root logging config
     logging.basicConfig(level=logging.INFO)
@@ -676,11 +821,23 @@ def main(argv: list[str] | None = None) -> int:
             log.info("prewarmed %d draft-group shapes in %.1fs",
                      n, time.monotonic() - t0)
         continuous.start()
+    debug_token = ""
+    if args.debug_token_file:
+        with open(args.debug_token_file, encoding="utf-8") as f:
+            debug_token = f.read().strip()
+    slo = None
+    if args.slo:
+        from kubeinfer_tpu.observability.slo import SLOObjective
+
+        slo = SLOMonitor(
+            objectives=tuple(SLOObjective.parse(s) for s in args.slo)
+        )
     srv = InferenceServer(
         engine, model_id=args.model, tokenizer=tokenizer,
         host=args.host, port=args.port, continuous=continuous,
         speculative=speculative, sp=sp_engine,
         tls_cert=args.tls_cert_file, tls_key=args.tls_key_file,
+        token=debug_token, slo=slo,
     ).start()
     log.info("native inference server on %s:%d (model %s)",
              args.host, srv.port, args.model)

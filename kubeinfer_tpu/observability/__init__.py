@@ -1,5 +1,6 @@
 """Request-scoped observability: tracing, trace-context propagation,
-Chrome trace export. See docs/OBSERVABILITY.md for the span model."""
+Chrome trace export, step-level engine profiling, the scheduler flight
+recorder, and SLO burn-rate monitoring. See docs/OBSERVABILITY.md."""
 
 from kubeinfer_tpu.observability.tracing import (
     RECORDER,
@@ -35,4 +36,9 @@ __all__ = [
     "parse_traceparent",
     "set_clock",
     "to_chrome_trace",
+    # step profiler / flight recorder / SLO monitor are intentionally
+    # NOT re-exported from the package root: tracing must stay an
+    # import leaf (its docstring contract), and the engine/server
+    # import the submodules directly — kubeinfer_tpu.observability
+    # .stepprof / .flightrecorder / .slo.
 ]

@@ -87,13 +87,19 @@ def main(argv=None) -> int:
         cont.stop()
 
     doc = tracing.RECORDER.to_chrome_trace(trace_id)
+    # the server's /debug/spans does this merge live; the artifact
+    # carries the same counter tracks so the checked-in demo shows the
+    # occupancy / queue-depth / kv-block curves next to the spans
+    srv._merge_counter_tracks(doc)
+    counters = sum(1 for e in doc["traceEvents"] if e.get("ph") == "C")
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=1) + "\n")
     spans = tracing.RECORDER.snapshot(trace_id)
     components = sorted({s.component for s in spans})
     print(f"trace {trace_id}: {len(spans)} spans across "
-          f"{len(components)} components {components}")
+          f"{len(components)} components {components}; "
+          f"{counters} counter samples")
     print(f"wrote {out} — open at https://ui.perfetto.dev")
     return 0
 

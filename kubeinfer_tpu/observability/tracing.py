@@ -353,7 +353,9 @@ def to_chrome_trace(spans: list[Span]) -> dict:
     ``docs/traces/``, loadable in Perfetto / chrome://tracing).
 
     Mapping: component -> pid (named via ``M`` metadata events), trace
-    -> tid (so one request's spans share a row), span -> ``X`` complete
+    -> tid (so one request's spans share a row, named ``trace <id..>``
+    via ``thread_name`` metadata — Perfetto then labels every row by
+    component/trace instead of raw integers), span -> ``X`` complete
     event, span event -> ``i`` instant. Times are microseconds as the
     format requires; trace/span/parent ids ride in ``args`` so the
     causal links survive the conversion.
@@ -367,8 +369,14 @@ def to_chrome_trace(spans: list[Span]) -> dict:
             "ph": "M", "name": "process_name", "pid": pid_of[c], "tid": 0,
             "args": {"name": c},
         })
+    # (pid, tid) -> trace id: one thread_name metadata event per row a
+    # component actually uses (tids are shared across components so one
+    # trace aligns horizontally across process groups)
+    rows: dict[tuple[int, int], str] = {}
     for s in spans:
         tid = tids.setdefault(s.trace_id, len(tids) + 1)
+        pid = pid_of[s.component]
+        rows.setdefault((pid, tid), s.trace_id)
         end = s.end if s.end is not None else s.start
         args = {
             "trace_id": s.trace_id,
@@ -378,16 +386,21 @@ def to_chrome_trace(spans: list[Span]) -> dict:
         args.update({k: v for k, v in s.attrs.items()})
         events.append({
             "ph": "X", "name": s.name, "cat": s.component,
-            "pid": pid_of[s.component], "tid": tid,
+            "pid": pid, "tid": tid,
             "ts": s.start * 1e6, "dur": max(0.0, (end - s.start) * 1e6),
             "args": args,
         })
         for ts, name, attrs in s.events:
             events.append({
                 "ph": "i", "s": "t", "name": name, "cat": s.component,
-                "pid": pid_of[s.component], "tid": tid, "ts": ts * 1e6,
+                "pid": pid, "tid": tid, "ts": ts * 1e6,
                 "args": dict(attrs),
             })
+    for (pid, tid), trace_id in sorted(rows.items()):
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"trace {trace_id[:8]}"},
+        })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -161,11 +161,19 @@ class TestChromeTrace:
         doc = rec.to_chrome_trace()
         assert doc["displayTimeUnit"] == "ms"
         evs = doc["traceEvents"]
-        metas = [e for e in evs if e["ph"] == "M"]
-        assert [m["args"]["name"] for m in metas] == ["comp-a"]
+        procs = [e for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"]
+        assert [m["args"]["name"] for m in procs] == ["comp-a"]
+        # each trace id also labels its row (thread) for Perfetto
+        threads = [e for e in evs
+                   if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert [m["args"]["name"] for m in threads] == [
+            f"trace {root.trace_id[:8]}"
+        ]
+        assert threads[0]["tid"] == 1  # first (only) trace -> first row
         (x,) = [e for e in evs if e["ph"] == "X"]
         assert x["name"] == "root"
-        assert x["pid"] == metas[0]["pid"]
+        assert x["pid"] == procs[0]["pid"]
         assert x["ts"] == pytest.approx(root.start * 1e6)
         assert x["dur"] >= 0.0
         assert x["args"]["trace_id"] == root.trace_id

@@ -1,0 +1,659 @@
+"""Engine observability layer: step profiler, flight recorder, SLO
+monitor, and their serving surfaces.
+
+Pins the PR 6 acceptance criteria: the profiler derives exact
+goodput/occupancy/padding-waste numbers from explicit timestamps (no
+wall-clock in the assertions), the flight recorder replays scheduler
+decisions and auto-dumps on in-flight failure, burn rates follow the
+SRE multi-window construction bit-for-bit, /metrics exposes the new
+series, the debug endpoints are token-gated, Chrome traces carry the
+counter tracks plus thread-name metadata, and NodeState heartbeats
+advertise the engine's stats_summary() through a store round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeinfer_tpu.observability import tracing
+from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
+from kubeinfer_tpu.observability.slo import (
+    DEFAULT_OBJECTIVES, SLOMonitor, SLOObjective,
+)
+from kubeinfer_tpu.observability.stepprof import StepProfiler
+from kubeinfer_tpu.observability.tracing import SpanRecorder, Tracer
+
+
+# --- step profiler ----------------------------------------------------------
+
+
+class TestStepProfiler:
+    def test_summary_is_exact_from_explicit_timestamps(self):
+        prof = StepProfiler(n_slots=4, name="test.StepProf.l1")
+        # one prefill (bucket 32, 24 live + 8 padded tokens) and two
+        # decode steps at half occupancy (2/4 rows; 2 padded rows each)
+        prof.record("prefill", bucket=32, live_rows=1, live_tokens=24,
+                    padded_tokens=8, start=100.0, end=100.5)
+        prof.record("decode", bucket=4, live_rows=2, live_tokens=2,
+                    padded_tokens=2, start=100.5, end=100.6)
+        prof.record("decode", bucket=4, live_rows=2, live_tokens=2,
+                    padded_tokens=2, start=100.6, end=100.7)
+        s = prof.summary(window_s=10.0, now=101.0)
+        assert s["steps"] == 3
+        assert s["goodput_tokens_per_sec"] == pytest.approx(28 / 10.0)
+        # occupancy averages over DECODE dispatches only
+        assert s["batch_occupancy"] == pytest.approx(0.5)
+        assert s["padding_waste_frac"] == pytest.approx(12 / 40)
+        assert s["compile_count"] == 2  # (prefill,32) and (decode,4)
+
+    def test_window_excludes_old_records(self):
+        prof = StepProfiler(n_slots=2, name="test.StepProf.l2")
+        prof.record("decode", bucket=2, live_rows=2, live_tokens=2,
+                    padded_tokens=0, start=10.0, end=10.1)
+        prof.record("decode", bucket=2, live_rows=1, live_tokens=1,
+                    padded_tokens=1, start=99.0, end=99.1)
+        s = prof.summary(window_s=5.0, now=100.0)
+        assert s["steps"] == 1
+        assert s["goodput_tokens_per_sec"] == pytest.approx(1 / 5.0)
+        assert s["batch_occupancy"] == pytest.approx(0.5)
+
+    def test_compile_detected_once_per_shape(self):
+        prof = StepProfiler(n_slots=2, name="test.StepProf.l3")
+        a = prof.record("prefill", bucket=16, live_rows=1, live_tokens=8,
+                        padded_tokens=8, start=0.0, end=1.0)
+        b = prof.record("prefill", bucket=16, live_rows=1, live_tokens=8,
+                        padded_tokens=8, start=1.0, end=1.1)
+        c = prof.record("prefill", bucket=32, live_rows=1, live_tokens=8,
+                        padded_tokens=24, start=1.1, end=2.0)
+        assert (a.compiled, b.compiled, c.compiled) == (True, False, True)
+        assert prof.compile_count == 2
+
+    def test_snapshot_cursor_replays_each_record_once(self):
+        prof = StepProfiler(n_slots=2, name="test.StepProf.l4")
+        for i in range(5):
+            prof.record("decode", bucket=2, live_rows=1, live_tokens=1,
+                        padded_tokens=1, start=float(i), end=float(i) + 0.1)
+        first = prof.snapshot(since_seq=-1)
+        assert [r.seq for r in first] == [0, 1, 2, 3, 4]
+        assert prof.snapshot(since_seq=first[-1].seq) == []
+        prof.record("decode", bucket=2, live_rows=1, live_tokens=1,
+                    padded_tokens=1, start=5.0, end=5.1)
+        assert [r.seq for r in prof.snapshot(since_seq=4)] == [5]
+
+    def test_ring_capacity_bounds_memory(self):
+        prof = StepProfiler(n_slots=2, capacity=4, name="test.StepProf.l5")
+        for i in range(10):
+            prof.record("decode", bucket=2, live_rows=1, live_tokens=1,
+                        padded_tokens=1, start=float(i), end=float(i) + 0.1)
+        recs = prof.snapshot()
+        assert [r.seq for r in recs] == [6, 7, 8, 9]
+
+    def test_kv_sampled_every_n_and_carried_forward(self):
+        calls = []
+
+        def kv():
+            calls.append(1)
+            return (7, 3)
+
+        prof = StepProfiler(n_slots=2, kv_sample_every=4, kv_stats=kv,
+                            name="test.StepProf.l6")
+        recs = [
+            prof.record("decode", bucket=2, live_rows=1, live_tokens=1,
+                        padded_tokens=1, start=float(i),
+                        end=float(i) + 0.1)
+            for i in range(6)
+        ]
+        assert len(calls) == 2  # seq 0 and seq 4
+        # carried forward in between, never missing once sampled
+        assert all(r.kv_in_use == 7 and r.kv_free == 3 for r in recs)
+
+    def test_counter_events_shape(self):
+        prof = StepProfiler(n_slots=2, name="test.StepProf.l7")
+        prof.record("decode", bucket=2, live_rows=2, live_tokens=2,
+                    padded_tokens=0, start=1.0, end=2.0)
+        evs = prof.counter_events(pid=9)
+        assert {e["name"] for e in evs} == {
+            "batch_occupancy", "padded_tokens"
+        }
+        assert all(e["ph"] == "C" and e["pid"] == 9 for e in evs)
+        occ = next(e for e in evs if e["name"] == "batch_occupancy")
+        assert occ["ts"] == pytest.approx(2.0 * 1e6)
+        assert occ["args"] == {"live_rows": 2}
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_unknown_kind_rejected(self):
+        fr = FlightRecorder(name="test.Flight.l1")
+        with pytest.raises(ValueError):
+            fr.note("reboot")
+
+    def test_ring_keeps_newest(self):
+        fr = FlightRecorder(capacity=3, name="test.Flight.l2")
+        for i in range(7):
+            fr.note("submit", queue_depth=i, t=float(i))
+        assert len(fr) == 3
+        assert [e.seq for e in fr.snapshot()] == [4, 5, 6]
+        d = fr.to_dict()
+        assert d["capacity"] == 3
+        assert d["recorded"] == 7  # total ever noted, not just retained
+
+    def test_render_replays_decisions_oldest_first(self):
+        fr = FlightRecorder(name="test.Flight.l3")
+        fr.note("backpressure", queue_depth=5, kv_in_use=30, kv_free=2,
+                t=1.5, need_blocks=4)
+        fr.note("evict", queue_depth=5, kv_in_use=28, kv_free=4, t=1.6,
+                nodes=2)
+        lines = fr.render().splitlines()
+        assert len(lines) == 2
+        assert "backpressure" in lines[0] and "need_blocks=4" in lines[0]
+        assert "queue=5" in lines[0] and "kv=30/32" in lines[0]
+        assert "evict" in lines[1]
+
+    def test_counter_events_skip_unsampled_kv(self):
+        fr = FlightRecorder(name="test.Flight.l4")
+        fr.note("submit", queue_depth=1, t=1.0)  # kv defaults to -1
+        fr.note("admit", queue_depth=0, kv_in_use=8, kv_free=8, t=2.0)
+        evs = fr.counter_events(pid=3)
+        depths = [e for e in evs if e["name"] == "queue_depth"]
+        kv = [e for e in evs if e["name"] == "kv_blocks"]
+        assert len(depths) == 2
+        assert len(kv) == 1
+        assert kv[0]["args"] == {"in_use": 8, "free": 8}
+
+
+# --- SLO monitor ------------------------------------------------------------
+
+
+class TestSLOMonitor:
+    def test_burn_rate_is_exact(self):
+        mon = SLOMonitor(
+            objectives=(SLOObjective("ttft", 1.0, 0.9),),
+            windows=(10.0, 100.0), name="test.SLO.l1",
+        )
+        # 4 requests in the short window, 1 bad: bad_frac 0.25 over a
+        # 0.1 budget -> burn 2.5
+        for t, v in ((95.0, 0.5), (96.0, 2.0), (97.0, 0.5), (98.0, 0.5)):
+            mon.observe("ttft", v, t=t)
+        rates = mon.burn_rates(now=100.0)["ttft"]
+        assert rates[10.0] == pytest.approx(2.5)
+        assert rates[100.0] == pytest.approx(2.5)
+        rem = mon.budget_remaining(now=100.0)["ttft"]
+        assert rem == pytest.approx(1.0 - 0.25 / 0.1)  # overrun: negative
+
+    def test_short_window_separates_fresh_regression(self):
+        mon = SLOMonitor(
+            objectives=(SLOObjective("ttft", 1.0, 0.9),),
+            windows=(10.0, 100.0), name="test.SLO.l2",
+        )
+        # old traffic all good; the last 10s all bad
+        for t in range(10, 60, 10):
+            mon.observe("ttft", 0.1, t=float(t))
+        mon.observe("ttft", 5.0, t=95.0)
+        rates = mon.burn_rates(now=100.0)["ttft"]
+        assert rates[10.0] == pytest.approx(10.0)  # 1/1 bad over 0.1
+        assert rates[100.0] == pytest.approx((1 / 6) / 0.1)
+
+    def test_empty_window_burns_nothing(self):
+        mon = SLOMonitor(name="test.SLO.l3")
+        rates = mon.burn_rates(now=1000.0)
+        assert all(
+            r == 0.0 for per in rates.values() for r in per.values()
+        )
+        assert all(
+            v == 1.0 for v in mon.budget_remaining(now=1000.0).values()
+        )
+
+    def test_unknown_objective_dropped(self):
+        mon = SLOMonitor(
+            objectives=(SLOObjective("ttft", 1.0, 0.9),),
+            name="test.SLO.l4",
+        )
+        mon.observe("nope", 100.0, t=1.0)  # must not raise or count
+        assert mon.burn_rates(now=2.0) == {"ttft": {
+            w: 0.0 for w in mon.windows
+        }}
+
+    def test_parse_spec(self):
+        obj = SLOObjective.parse("ttft:0.5:0.99")
+        assert obj == SLOObjective("ttft", 0.5, 0.99)
+        assert obj.budget == pytest.approx(0.01)
+        for bad in ("ttft:0.5", "ttft:0.5:1.5", "ttft:0:0.9"):
+            with pytest.raises(ValueError):
+                SLOObjective.parse(bad)
+
+    def test_snapshot_carries_counts_for_audit(self):
+        mon = SLOMonitor(
+            objectives=(SLOObjective("tpot", 0.1, 0.5),),
+            windows=(60.0,), name="test.SLO.l5",
+        )
+        mon.observe("tpot", 0.2, t=10.0)
+        mon.observe("tpot", 0.05, t=11.0)
+        snap = mon.snapshot(now=20.0)
+        w = snap["objectives"]["tpot"]["windows"]["60"]
+        assert (w["bad"], w["total"]) == (1, 2)
+        assert w["burn_rate"] == pytest.approx(1.0)  # 0.5/0.5
+        assert snap["objectives"]["tpot"]["budget_remaining"] == \
+            pytest.approx(0.0)
+
+    def test_defaults_cover_the_breakdown_metrics(self):
+        assert {o.name for o in DEFAULT_OBJECTIVES} == {
+            "ttft", "tpot", "queue_wait"
+        }
+
+
+# --- span recorder under concurrent writers (satellite) ---------------------
+
+
+class TestSpanRecorderConcurrency:
+    def test_ring_overwrite_keeps_newest_without_torn_entries(self):
+        capacity, n_threads, per_thread = 64, 8, 200
+        rec = SpanRecorder(capacity=capacity, name="test.ConcRec.l1")
+
+        def writer(t: int) -> None:
+            tr = Tracer(f"w{t}", recorder=rec)
+            for i in range(per_thread):
+                tr.record_span(f"w{t}-{i}", start=float(i),
+                               end=float(i) + 1.0, thread=t, index=i)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        spans = rec.snapshot()
+        assert len(spans) == capacity
+        by_thread: dict[int, list[int]] = {}
+        for s in spans:
+            # no torn entries: every surviving span is internally
+            # consistent (name agrees with attrs, end stamped)
+            t, i = s.attrs["thread"], s.attrs["index"]
+            assert s.name == f"w{t}-{i}"
+            assert s.end == pytest.approx(s.start + 1.0)
+            by_thread.setdefault(t, []).append(i)
+        # newest win: the ring holds the last `capacity` appends, so
+        # each thread's survivors are a CONTIGUOUS tail slice of its
+        # own append order — a surviving older span with a missing
+        # newer one would mean the ring dropped from the wrong end
+        for idxs in by_thread.values():
+            assert idxs == list(range(idxs[0], idxs[0] + len(idxs)))
+
+
+# --- engine integration -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+    cfg = PRESETS["tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousEngine(params, cfg, n_slots=2, cache_len=64).start()
+    try:
+        yield eng
+    finally:
+        eng.stop()
+
+
+class TestEngineInstrumentation:
+    def test_profiler_records_prefill_and_decode(self, engine):
+        before = engine.profiler.snapshot()
+        cursor = before[-1].seq if before else -1
+        out = engine.generate([1, 2, 3, 4], max_new_tokens=3)
+        assert len(out) == 3
+        recs = engine.profiler.snapshot(since_seq=cursor)
+        phases = [r.phase for r in recs]
+        assert "prefill" in phases and "decode" in phases
+        pre = next(r for r in recs if r.phase == "prefill")
+        # suffix bucketing: 4-token prompt pads to the 4 bucket
+        assert pre.bucket >= 4
+        assert pre.live_tokens == 4
+        assert pre.padded_tokens == pre.bucket - 4
+        assert pre.dur_s >= 0.0
+        for d in (r for r in recs if r.phase == "decode"):
+            # this engine is n_slots=2: one live request decodes at
+            # half occupancy, one padded row
+            assert d.n_slots == 2
+            assert d.live_rows >= 1
+            assert d.live_tokens == d.live_rows
+            assert d.live_rows + d.padded_tokens == 2
+
+    def test_flight_recorder_sees_the_request_lifecycle(self, engine):
+        n_before = len(engine.flight)
+        engine.generate([5, 6, 7], max_new_tokens=2)
+        new = [e for e in engine.flight.snapshot()][n_before:]
+        kinds = [e.kind for e in new]
+        assert "submit" in kinds and "admit" in kinds and "retire" in kinds
+        admit = next(e for e in new if e.kind == "admit")
+        assert admit.kv_in_use >= 0 and admit.kv_free >= 0
+        assert "slot" in admit.detail and "suffix_bucket" in admit.detail
+        retire = next(e for e in new if e.kind == "retire")
+        assert retire.detail["tokens"] == 2
+
+    def test_stats_summary_shape_and_sanity(self, engine):
+        engine.generate([9, 8, 7], max_new_tokens=2)
+        s = engine.stats_summary()
+        assert set(s) == {
+            "n_slots", "queue_depth", "batch_occupancy",
+            "goodput_tokens_per_sec", "padding_waste_frac",
+            "kv_blocks_free", "kv_blocks_in_use", "prefix_hit_rate",
+        }
+        assert s["n_slots"] == 2
+        assert s["queue_depth"] == 0  # nothing in flight now
+        assert 0.0 <= s["batch_occupancy"] <= 1.0
+        assert 0.0 <= s["padding_waste_frac"] <= 1.0
+        assert 0.0 <= s["prefix_hit_rate"] <= 1.0
+        assert s["goodput_tokens_per_sec"] > 0.0
+        assert s["kv_blocks_free"] + s["kv_blocks_in_use"] > 0
+        json.dumps(s)  # heartbeat embeds it verbatim: must serialize
+
+    def test_fail_inflight_dumps_flight_recorder(self, caplog):
+        from kubeinfer_tpu.inference import PRESETS, init_params
+        from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        # never started: the scheduler thread must not race the
+        # admit/fail below, making the in-flight state deterministic
+        eng = ContinuousEngine(params, cfg, n_slots=2, cache_len=64)
+        req = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng._admit_pending()  # places the request into slot 0
+        assert any(r is req for r in eng._slot_req)
+        with caplog.at_level(
+            "WARNING", logger="kubeinfer_tpu.inference.batching"
+        ):
+            eng._fail_inflight()
+        assert req.done.is_set() and req.failed
+        kinds = [e.kind for e in eng.flight.snapshot()]
+        assert kinds[-1] == "fail_inflight"
+        assert "flight recorder dump" in caplog.text
+        # the dump replays the lead-up decisions, not just the failure
+        assert "submit" in caplog.text and "admit" in caplog.text
+        # second sweep (stop() + epilogue both run it): nothing left in
+        # flight, so no second dump
+        n_events = len(eng.flight)
+        eng._fail_inflight()
+        assert len(eng.flight) == n_events
+
+
+# --- serving surfaces: /metrics, debug endpoints, counter tracks ------------
+
+
+@pytest.fixture(scope="module")
+def serving(engine):
+    from kubeinfer_tpu.inference.engine import Engine
+    from kubeinfer_tpu.inference.server import InferenceServer
+
+    srv = InferenceServer(
+        Engine(engine.params, engine.cfg), model_id="obs-tiny", port=0,
+        continuous=engine,
+    ).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _post_completion(srv, body: dict):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/completions",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _get(srv, path: str, token: str | None = None):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", headers=headers
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read()
+
+
+class TestServingMetrics:
+    def test_engine_series_on_metrics(self, serving):
+        _post_completion(serving, {"prompt": [1, 2, 3], "max_tokens": 3})
+        _, body = _get(serving, "/metrics")
+        text = body.decode()
+        for family, typ in (
+            ("kubeinfer_engine_goodput_tokens_per_second", "gauge"),
+            ("kubeinfer_engine_batch_occupancy", "gauge"),
+            ("kubeinfer_engine_padding_waste_frac", "gauge"),
+            ("kubeinfer_engine_queue_depth", "gauge"),
+            ("kubeinfer_engine_step_duration_seconds", "histogram"),
+            ("kubeinfer_engine_compiles_total", "counter"),
+            ("kubeinfer_slo_burn_rate", "gauge"),
+            ("kubeinfer_slo_budget_remaining", "gauge"),
+        ):
+            assert f"# TYPE {family} {typ}" in text
+        m = serving.metrics
+        assert m["step_duration"].count("prefill") >= 1
+        assert m["step_duration"].count("decode") >= 1
+        assert m["compiles"].value() >= 1
+        assert m["occupancy"].value() > 0.0
+        assert m["goodput"].value() > 0.0
+
+    def test_step_records_fold_into_histogram_once(self, serving):
+        _post_completion(serving, {"prompt": [4, 4], "max_tokens": 2})
+        _get(serving, "/metrics")
+        count = serving.metrics["step_duration"].count("decode")
+        # a second scrape with no new steps must not re-observe
+        _get(serving, "/metrics")
+        assert serving.metrics["step_duration"].count("decode") == count
+
+    def test_slo_gauges_follow_observations_exactly(self, serving):
+        # default ttft objective: threshold 2.0s, objective 0.99. One
+        # fabricated 100s observation in an otherwise-empty short
+        # window would make burn = bad_frac / 0.01; feed via the same
+        # monitor the breakdown path uses, then scrape
+        mon = serving.slo
+        t = tracing.now()
+        mon.observe("ttft", 100.0, t=t)
+        _get(serving, "/metrics")
+        burn = serving.metrics["slo_burn"].value("ttft", "60s")
+        counts = mon._window_counts("ttft", tracing.now())[60.0]
+        assert burn == pytest.approx(
+            (counts[0] / counts[1]) / 0.01
+        )
+        assert burn > 0.0
+        assert serving.metrics["slo_budget"].value("ttft") < 1.0
+
+    def test_breakdown_feeds_slo_monitor(self, serving):
+        before = {
+            name: len(ring) for name, ring in serving.slo._obs.items()
+        }
+        _post_completion(serving, {"prompt": [7, 7, 7], "max_tokens": 2})
+        after = {
+            name: len(ring) for name, ring in serving.slo._obs.items()
+        }
+        for name in ("ttft", "tpot", "queue_wait"):
+            assert after[name] == before[name] + 1
+
+    def test_debug_flightrecorder_endpoint(self, serving):
+        _post_completion(serving, {"prompt": [2, 2], "max_tokens": 2})
+        _, body = _get(serving, "/debug/flightrecorder")
+        doc = json.loads(body)
+        assert doc["capacity"] > 0
+        kinds = {e["kind"] for e in doc["events"]}
+        assert {"submit", "admit", "retire"} <= kinds
+        for e in doc["events"]:
+            assert {"seq", "t", "kind", "queue_depth", "kv_in_use",
+                    "kv_free", "detail"} <= set(e)
+
+    def test_debug_slo_endpoint(self, serving):
+        _, body = _get(serving, "/debug/slo")
+        doc = json.loads(body)
+        assert {"ttft", "tpot", "queue_wait"} <= set(doc["objectives"])
+        ttft = doc["objectives"]["ttft"]
+        assert set(ttft["windows"]) == {"60", "300", "1800"}
+        for w in ttft["windows"].values():
+            assert {"bad", "total", "burn_rate"} <= set(w)
+
+    def test_debug_spans_carries_counter_tracks(self, serving):
+        _post_completion(serving, {"prompt": [3, 3, 3], "max_tokens": 2})
+        _, body = _get(serving, "/debug/spans")
+        doc = json.loads(body)
+        evs = doc["traceEvents"]
+        counters = {e["name"] for e in evs if e["ph"] == "C"}
+        assert {"batch_occupancy", "padded_tokens", "queue_depth",
+                "kv_blocks"} <= counters
+        procs = {
+            e["args"]["name"] for e in evs
+            if e.get("name") == "process_name"
+        }
+        assert "engine-counters" in procs
+        # counter events live in their own process group, after the
+        # span pids (so Perfetto renders them as separate tracks)
+        counter_pid = next(
+            e["pid"] for e in evs
+            if e.get("name") == "process_name"
+            and e["args"]["name"] == "engine-counters"
+        )
+        span_pids = {e["pid"] for e in evs if e["ph"] == "X"}
+        assert counter_pid not in span_pids
+
+    def test_thread_name_metadata_labels_trace_rows(self, serving):
+        ctx = tracing.new_root_context()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{serving.port}/v1/completions",
+            data=json.dumps(
+                {"prompt": [6, 6], "max_tokens": 2}
+            ).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "traceparent": ctx.traceparent()},
+        )
+        with urllib.request.urlopen(req, timeout=120):
+            pass
+        _, body = _get(serving, f"/debug/spans?trace_id={ctx.trace_id}")
+        doc = json.loads(body)
+        names = [
+            e for e in doc["traceEvents"] if e.get("name") == "thread_name"
+        ]
+        assert names
+        assert all(
+            e["args"]["name"] == f"trace {ctx.trace_id[:8]}"
+            for e in names
+        )
+
+
+class TestDebugAuth:
+    @pytest.fixture()
+    def armed(self, engine):
+        from kubeinfer_tpu.inference.engine import Engine
+        from kubeinfer_tpu.inference.server import InferenceServer
+
+        srv = InferenceServer(
+            Engine(engine.params, engine.cfg), model_id="authy", port=0,
+            continuous=engine, token="sekrit",
+        ).start()
+        try:
+            yield srv
+        finally:
+            srv.stop()
+
+    @pytest.mark.parametrize("path", [
+        "/debug/spans", "/debug/flightrecorder", "/debug/slo",
+    ])
+    def test_debug_requires_token_when_armed(self, armed, path):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(armed, path)
+        assert exc.value.code == 401
+        assert json.loads(exc.value.read()) == {"error": "unauthorized"}
+        status, body = _get(armed, path, token="sekrit")
+        assert status == 200
+        json.loads(body)
+
+    def test_wrong_token_rejected(self, armed):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(armed, "/debug/slo", token="wrong")
+        assert exc.value.code == 401
+
+    def test_health_and_metrics_stay_open(self, armed):
+        status, body = _get(armed, "/health")
+        assert status == 200 and body == b"OK"
+        status, _ = _get(armed, "/metrics")
+        assert status == 200
+
+
+# --- heartbeat advertisement ------------------------------------------------
+
+
+class TestHeartbeatServingStats:
+    def test_heartbeat_round_trips_serving_stats(self, tmp_path):
+        from kubeinfer_tpu.agent.node_agent import NodeAgent
+        from kubeinfer_tpu.api.workload import NodeState
+        from kubeinfer_tpu.controlplane.store import Store
+
+        store = Store()
+        stats = {"n_slots": 2, "queue_depth": 1,
+                 "goodput_tokens_per_sec": 12.5, "batch_occupancy": 0.75}
+        na = NodeAgent(
+            store, "node-obs", gpu_capacity=4,
+            gpu_memory_bytes=1 << 30, model_root=str(tmp_path),
+            serving_stats=lambda: stats,
+        )
+        na._heartbeat()
+        state = NodeState.from_dict(store.get(NodeState.KIND, "node-obs"))
+        assert state.serving_stats == stats
+        # second beat UPDATES the same object through the store
+        stats2 = dict(stats, queue_depth=0)
+        na._serving_stats = lambda: stats2
+        na._heartbeat()
+        state = NodeState.from_dict(store.get(NodeState.KIND, "node-obs"))
+        assert state.serving_stats == stats2
+        assert state.to_dict()["servingStats"] == stats2
+
+    def test_failing_stats_callback_never_kills_the_heartbeat(
+            self, tmp_path):
+        from kubeinfer_tpu.agent.node_agent import NodeAgent
+        from kubeinfer_tpu.api.workload import NodeState
+        from kubeinfer_tpu.controlplane.store import Store
+
+        store = Store()
+
+        def boom():
+            raise RuntimeError("stats backend down")
+
+        na = NodeAgent(
+            store, "node-boom", gpu_capacity=4,
+            gpu_memory_bytes=1 << 30, model_root=str(tmp_path),
+            serving_stats=boom,
+        )
+        na._heartbeat()  # must not raise
+        state = NodeState.from_dict(store.get(NodeState.KIND, "node-boom"))
+        assert state.serving_stats == {}
+        assert state.heartbeat > 0.0
+
+    def test_engine_summary_is_heartbeatable(self, engine, tmp_path):
+        from kubeinfer_tpu.agent.node_agent import NodeAgent
+        from kubeinfer_tpu.api.workload import NodeState
+        from kubeinfer_tpu.controlplane.store import Store
+
+        store = Store()
+        engine.generate([1, 2], max_new_tokens=2)
+        na = NodeAgent(
+            store, "node-live", gpu_capacity=4,
+            gpu_memory_bytes=1 << 30, model_root=str(tmp_path),
+            serving_stats=engine.stats_summary,
+        )
+        na._heartbeat()
+        state = NodeState.from_dict(store.get(NodeState.KIND, "node-live"))
+        assert state.serving_stats["n_slots"] == 2
+        assert state.serving_stats["goodput_tokens_per_sec"] >= 0.0
+        assert 0.0 <= state.serving_stats["prefix_hit_rate"] <= 1.0

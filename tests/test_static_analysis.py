@@ -717,6 +717,106 @@ def test_log_discipline_allow_suppresses():
     assert fs == []
 
 
+# --- metric-name ------------------------------------------------------------
+
+
+def test_metric_missing_prefix_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Counter
+
+        c = Counter("requests_total", "requests")
+        """
+    )
+    assert rules_of(fs) == ["metric-name"]
+    assert "kubeinfer_" in fs[0].message
+
+
+def test_counter_without_total_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Counter
+
+        c = Counter("kubeinfer_requests", "requests")
+        """
+    )
+    assert rules_of(fs) == ["metric-name"]
+    assert "_total" in fs[0].message
+
+
+def test_histogram_without_unit_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Histogram
+
+        h = Histogram("kubeinfer_request_latency", "latency")
+        """
+    )
+    assert rules_of(fs) == ["metric-name"]
+
+
+def test_gauge_without_quantity_suffix_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Gauge
+
+        g = Gauge("kubeinfer_goodput", "tokens per second")
+        """
+    )
+    assert rules_of(fs) == ["metric-name"]
+
+
+def test_computed_metric_name_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Counter
+
+        def make(component):
+            return Counter(f"kubeinfer_{component}_total", "per component")
+        """
+    )
+    assert rules_of(fs) == ["metric-name"]
+    assert "literal" in fs[0].message
+
+
+def test_compliant_collectors_clean():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import (
+            Counter, Gauge, Histogram,
+        )
+
+        c = Counter("kubeinfer_requests_total", "requests")
+        h = Histogram("kubeinfer_request_seconds", "latency")
+        g1 = Gauge("kubeinfer_ready_replicas", "replicas")
+        g2 = Gauge("kubeinfer_stale_seconds", "staleness")
+        g3 = Gauge("kubeinfer_goodput_tokens_per_second", "goodput")
+        """
+    )
+    assert fs == []
+
+
+def test_collections_counter_not_matched():
+    fs = run_src(
+        """
+        import collections
+
+        hist = collections.Counter(["a", "b", "a"])
+        """
+    )
+    assert fs == []
+
+
+def test_metric_name_rule_off_for_test_files():
+    src = """
+    from kubeinfer_tpu.metrics.registry import Counter
+
+    c = Counter("t_total", "fixture counter")
+    """
+    assert run_src(src, path="tests/test_metrics.py") == []
+    assert rules_of(run_src(src, path="pkg/server.py")) == ["metric-name"]
+
+
 # --- the tier-1 gate --------------------------------------------------------
 
 
