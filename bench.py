@@ -707,6 +707,133 @@ def serving_trace_bench(n_requests=16, prompt_len=256, max_new=8,
     }
 
 
+def serving_slo_bench(n_slots=4, cache_len=1024, model="bench-280m",
+                      seed=13, n_long=4, n_short=16, long_new=64,
+                      short_new=4, chunk_blocks=4):
+    """Heavy-tail arrival SLO phase: does chunked prefill + SLO-aware
+    preemption actually protect tail TTFT?
+
+    The workload is the head-of-line case the scheduler PR exists for:
+    a seeded burst of long-context prompts lands ahead of a train of
+    short interactive ones, so without intervention the shorts wait out
+    the longs' full residency (prefill + ``long_new`` decode steps).
+    The phase runs the SAME seeded workload twice on fresh engines —
+    once with chunking + preemption enabled, once with both disabled
+    (the pre-PR single-dispatch admit) — and publishes p99 TTFT from
+    the request timeline fields (t_first - t_submit, the same fields
+    the server's histograms read) for each, plus goodput from a
+    StepProfiler cursor bracket around each measured phase so the
+    tail-latency win is shown not to come out of throughput.
+
+    Both engines get an identical warmup sweep covering every compiled
+    shape the measured phase can touch (long admit, short/resume
+    suffix buckets 16/32/64, the chunk shape, the decode step) so the
+    comparison measures scheduling policy, not jit compiles.
+
+    CPU-pinned for the same reason as serving_trace_bench: these are
+    scheduling-layer wall-clock effects and the axon relay's jittery
+    transport tax would swamp them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import (
+        ContinuousEngine, PreemptionPolicy,
+    )
+    from kubeinfer_tpu.observability import tracing
+
+    cfg = PRESETS[model]
+    rng = np.random.default_rng(seed)
+    # seeded mix: long prompts at/near the 512 bucket boundary, shorts
+    # one block. Near-boundary lengths keep the two runs' prefill
+    # compute equal (the unchunked run pads to the 512 bucket, the
+    # chunked run computes exact chunks — a shorter long prompt would
+    # gift the chunked run a padding discount and muddy the goodput
+    # comparison); lengths still vary so the radix trie sees distinct
+    # prefixes. The arrival ORDER is fixed longs-first — the
+    # adversarial head-of-line case this phase measures.
+    workload = [
+        (rng.integers(0, cfg.vocab_size,
+                      int(rng.choice([480, 496, 512]))).tolist(),
+         long_new)
+        for _ in range(n_long)
+    ] + [
+        (rng.integers(0, cfg.vocab_size,
+                      int(rng.integers(8, 17))).tolist(), short_new)
+        for _ in range(n_short)
+    ]
+    policy = PreemptionPolicy(
+        threshold_s=0.05, objective=0.5, burn_limit=0.5,
+        cooldown_steps=4, min_progress=2,
+    )
+
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+
+        def _run(blocks, pol):
+            eng = ContinuousEngine(
+                params, cfg, n_slots=n_slots, cache_len=cache_len,
+                block_size=32, prefill_chunk_blocks=blocks,
+                preemption=pol,
+            ).start()
+            try:
+                # warm every shape the measured phase can dispatch;
+                # prompt lengths chosen so both configurations compile
+                # the union (512 hits bucket 512 unchunked / the chunk
+                # shape + its 128-bucket final suffix chunked; 12 and
+                # 24 hit the 16/32 buckets shorts and resume tails use)
+                for wlen in (512, 12, 24):
+                    eng.generate(
+                        rng.integers(0, cfg.vocab_size, wlen).tolist(),
+                        max_new_tokens=4,
+                    )
+                    _touch_progress()
+                prof = eng.profiler.snapshot()
+                prof_seq = prof[-1].seq if prof else -1
+                t0 = tracing.now()
+                reqs = [
+                    eng.submit(p, max_new_tokens=mn)
+                    for p, mn in workload
+                ]
+                for r in reqs:
+                    if not r.done.wait(timeout=300):
+                        raise TimeoutError("SLO-phase request timed out")
+                    _touch_progress()
+                phase_s = max(tracing.now() - t0, 1e-9)
+                steps = eng.profiler.snapshot(since_seq=prof_seq)
+                goodput = sum(r.live_tokens for r in steps) / phase_s
+                ttfts = [r.t_first - r.t_submit for r in reqs]
+                sched = eng.scheduler_stats()
+            finally:
+                eng.stop()
+            return ttfts, goodput, sched
+
+        on_ttfts, on_goodput, on_sched = _run(chunk_blocks, policy)
+        off_ttfts, off_goodput, _ = _run(0, None)
+    finally:
+        jax.config.update("jax_default_device", prev_dev)
+    return {
+        "ttft_ms_p99_heavytail": round(
+            float(np.percentile(np.asarray(on_ttfts), 99)) * 1e3, 3
+        ),
+        "ttft_ms_p99_heavytail_nochunk": round(
+            float(np.percentile(np.asarray(off_ttfts), 99)) * 1e3, 3
+        ),
+        "goodput_tokens_per_sec_heavytail": round(on_goodput, 3),
+        "goodput_tokens_per_sec_heavytail_nochunk": round(
+            off_goodput, 3
+        ),
+        "preemptions_heavytail": on_sched["preempted"],
+        "prefill_chunks_heavytail": on_sched["chunks"],
+        "arrival_mix_seed": seed,
+    }
+
+
 _last_progress = [0.0]
 
 
@@ -1094,6 +1221,30 @@ def main() -> None:
             extras["padding_waste_frac"] = tr["padding_waste_frac"]
         except Exception as e:
             extras["serving_trace_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # the serving sections above and below pin to the host CPU
+        # backend by construction (their docstrings say why); publish
+        # which backend served them so round-over-round comparisons
+        # never silently mix backends
+        extras["serving_backend"] = "cpu"
+        # heavy-tail arrival SLO phase (chunked-prefill/preemption PR):
+        # p99 TTFT with the scheduler's chunking + preemption on vs the
+        # pre-PR single-dispatch admit, same seeded workload, plus the
+        # goodput bracket showing the tail win is not bought with
+        # throughput
+        try:
+            slo = serving_slo_bench(n_slots=4)
+            for key in (
+                "ttft_ms_p99_heavytail",
+                "ttft_ms_p99_heavytail_nochunk",
+                "goodput_tokens_per_sec_heavytail",
+                "goodput_tokens_per_sec_heavytail_nochunk",
+                "preemptions_heavytail", "prefill_chunks_heavytail",
+                "arrival_mix_seed",
+            ):
+                extras[key] = slo[key]
+        except Exception as e:
+            extras["serving_slo_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
 
     print(

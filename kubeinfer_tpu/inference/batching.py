@@ -32,6 +32,7 @@ host, math under jit).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import logging
@@ -50,6 +51,7 @@ from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
+from kubeinfer_tpu.observability.slo import SLOMonitor, SLOObjective
 from kubeinfer_tpu.observability.stepprof import StepProfiler
 
 log = logging.getLogger(__name__)
@@ -332,7 +334,106 @@ def _admit_slot(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _prefill_chunk(
+    params: Params,
+    state: SlotState,
+    window: jax.Array,  # i32[1, C] prompt tokens [pos, pos + C)
+    pos: jax.Array,  # i32[] chunk start position in the logical row
+    cfg: ModelConfig,
+    table_row: jax.Array,  # i32[max_blocks] this slot's block table
+    own_mask: jax.Array,  # bool[max_blocks] True = freshly allocated block
+) -> SlotState:
+    """Commit ONE fixed-size prefill chunk's KV into the pool — no
+    sampling, no slot-state installation (``_admit_slot`` finishes the
+    tail and flips the slot live in one dispatch, so the row is never
+    half-visible to the decode batch: its table stays all-null and
+    ``active`` stays False until the final chunk).
+
+    Same gather/scatter shape as ``_admit_slot``: the row's logical view
+    through ``table_row`` (earlier chunks' KV arrives committed), dense
+    forward over the window at ``cache_offset=pos``, own-masked write
+    back (shared radix-prefix blocks are never rewritten). The window is
+    always entirely inside the prompt, so the plain causal mask over
+    logical positions is exactly ``_admit_slot``'s prompt-limited mask
+    restricted to these queries — chunked and whole-suffix prefill
+    commit bit-identical KV. ``return_hidden=True`` skips the lm-head
+    matmul: intermediate chunks sample nothing, so the vocab projection
+    is paid once per prompt (in the final ``_admit_slot``), not once per
+    chunk. Compiled once per chunk width C (a fixed multiple of
+    block_size), never per prompt length."""
+    T = window.shape[1]
+    nb, bs, n_kv, D = state.caches_k[0].shape
+    M = table_row.shape[0]
+    S = M * bs
+    q_pos = pos + jnp.arange(T)
+    cache_pos = jnp.arange(S)
+    mask = cache_pos[None, None, :] <= q_pos[None, :, None]
+    caches = [
+        (
+            ck[table_row].reshape(1, S, n_kv, D),
+            cv[table_row].reshape(1, S, n_kv, D),
+        )
+        for ck, cv in zip(state.caches_k, state.caches_v)
+    ]
+    _, caches = forward(
+        params, window, cfg, positions=q_pos[None, :], attn_mask=mask,
+        kv_caches=caches, cache_offset=pos, return_hidden=True,
+    )
+
+    own = own_mask[:, None, None, None]
+
+    def put(pool, view):
+        new_blocks = view.reshape(M, bs, n_kv, D)
+        return pool.at[table_row].set(
+            jnp.where(own, new_blocks, pool[table_row])
+        )
+
+    return dataclasses.replace(
+        state,
+        caches_k=[put(b, c[0]) for b, c in zip(state.caches_k, caches)],
+        caches_v=[put(b, c[1]) for b, c in zip(state.caches_v, caches)],
+    )
+
+
 # --- host-side scheduler ---------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PreemptionPolicy:
+    """SLO-aware preemption knobs (vLLM preempts by full recompute; the
+    radix trie makes park-and-readmit nearly free here, so the policy
+    can afford to fire on queue-wait pressure alone).
+
+    A waiter triggers preemption only when ALL of: its wait exceeds
+    ``threshold_s``, the engine-private queue_wait SLO burn rate has
+    reached ``burn_limit`` (burn 1.0 = spending error budget exactly at
+    the sustainable rate), at least ``cooldown_steps`` decode steps ran
+    since the last preemption, and some victim has decoded at least
+    ``min_progress`` tokens since its own (re)admission. The last two
+    are the anti-livelock levers: every park is preceded by guaranteed
+    forward progress, so an oversubscribed engine round-robins rather
+    than thrashes."""
+
+    threshold_s: float = 0.5
+    objective: float = 0.9  # good fraction target for the private SLO
+    burn_limit: float = 1.0
+    cooldown_steps: int = 4
+    min_progress: int = 2
+
+    @classmethod
+    def parse(cls, spec: str) -> "PreemptionPolicy":
+        """``THRESHOLD_S[:BURN_LIMIT]`` — the --preemption-slo CLI
+        syntax, e.g. ``0.5`` or ``0.5:2.0``."""
+        parts = spec.split(":")
+        if len(parts) > 2:
+            raise ValueError(
+                f"preemption spec {spec!r} is not THRESHOLD_S[:BURN_LIMIT]"
+            )
+        kw: dict = {"threshold_s": float(parts[0])}
+        if len(parts) == 2:
+            kw["burn_limit"] = float(parts[1])
+        return cls(**kw)
 
 
 @dataclass
@@ -363,12 +464,45 @@ class _Request:
     t_first: float = 0.0
     t_done: float = 0.0
     token_times: list[float] = field(default_factory=list)
+    # preemption bookkeeping: t_parked restarts the request's place in
+    # the longest-pending-first admission order (a just-parked victim
+    # goes to the back of the line — the anti-livelock invariant);
+    # tokens_at_admit anchors the min_progress victim guard to the
+    # CURRENT residency, not lifetime output
+    t_parked: float = 0.0
+    preemptions: int = 0
+    tokens_at_admit: int = 0
+
+    @property
+    def pending_since(self) -> float:
+        return self.t_parked or self.t_submit
 
     def cancel(self) -> None:
         """Abandon the request: the scheduler drops it before admission
         or retires its slot at the next step, instead of decoding tokens
         nobody will read."""
         self.cancelled.set()
+
+
+@dataclass
+class _PrefillTask:
+    """One in-progress chunked prefill: the slot is reserved (its
+    ``_slot_req`` entry set, blocks held) but the row stays inactive —
+    the decode batch keeps stepping other slots between chunks.
+    ``tokens`` is the EFFECTIVE prompt (original prompt + any tokens
+    generated before a preemption), frozen at plan time; ``pos`` is the
+    next logical position to prefill (starts at the radix-matched
+    offset, advances one chunk per scheduler pass)."""
+
+    req: _Request
+    slot: int
+    table_row: np.ndarray  # i32[max_blocks]
+    own_mask: np.ndarray  # bool[max_blocks]
+    reuse: int  # radix-matched full blocks
+    total: int  # blocks held by the slot (prompt + decode horizon)
+    pos: int
+    tokens: list[int]
+    resumed: bool
 
 
 class ContinuousEngine:
@@ -392,7 +526,9 @@ class ContinuousEngine:
     def __init__(self, params: Params, cfg: ModelConfig,
                  n_slots: int = 8, cache_len: int = 1024,
                  speculative=None, block_size: int | None = None,
-                 num_blocks: int | None = None) -> None:
+                 num_blocks: int | None = None,
+                 prefill_chunk_blocks: int = 0,
+                 preemption: PreemptionPolicy | None = None) -> None:
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -426,6 +562,43 @@ class ContinuousEngine:
             )
         self._pool = BlockPool(num_blocks, self.block_size)
         self._radix = RadixCache(self._pool)
+        # chunked prefill: intermediate chunks are exactly this many
+        # tokens (k full blocks — ONE compiled shape), the tail rides
+        # the existing _admit_slot bucket traces. 0 disables, restoring
+        # the single-dispatch admit.
+        if prefill_chunk_blocks < 0:
+            raise ValueError(
+                f"prefill_chunk_blocks must be >= 0, got "
+                f"{prefill_chunk_blocks}"
+            )
+        self.chunk_tokens = prefill_chunk_blocks * self.block_size
+        # SLO-aware preemption: the engine owns a PRIVATE monitor (the
+        # server's SLOMonitor aggregates every route; feeding the
+        # scheduler from it would double-count queue_wait and couple
+        # admission policy to scrape configuration). Observations land
+        # at admit time plus a live head-wait probe in _maybe_preempt,
+        # so a wedged engine with no admits still sees its burn rise.
+        self.preemption = preemption
+        self._slo: SLOMonitor | None = None
+        if preemption is not None:
+            self._slo = SLOMonitor(
+                objectives=(SLOObjective(
+                    "queue_wait", preemption.threshold_s,
+                    preemption.objective,
+                ),),
+                windows=(30.0, 300.0),
+                name="batching.SLOMonitor._lock",
+            )
+        # chunked prefills in flight (at most one chunk dispatched per
+        # scheduler pass, FIFO) and preempted requests awaiting readmit
+        self._prefills: list[_PrefillTask] = []
+        self._parked: list[_Request] = []
+        # cooldown ticks on decode steps; start past the gate so the
+        # first pressure spike can preempt immediately
+        self._steps_since_preempt = 1 << 30
+        self.preempted_total = 0  # telemetry: rows parked
+        self.resumed_total = 0  # telemetry: parked rows readmitted
+        self.chunks_total = 0  # telemetry: intermediate chunk dispatches
         # step-level observability (docs/OBSERVABILITY.md): one record
         # per device dispatch, plus the scheduler-decision flight ring.
         # The kv_stats callback reads the pool's own locked counters and
@@ -456,9 +629,12 @@ class ContinuousEngine:
         self.spec_accepted = 0  # telemetry: accepted draft tokens, all groups
         # (member requests, live group handle) — at most one in flight
         self._spec_group: tuple[list[_Request], object] | None = None
-        # arrival-order head popped from the queue but not yet placeable
-        # (no free slot / not group-joinable); served before the queue
-        self._holdover: _Request | None = None
+        # arrival-order heads popped from the queue but not yet
+        # placeable (no free slot / not group-joinable); served before
+        # the queue. A deque (oldest first) rather than a single slot:
+        # preemption interleaves parked readmits with fresh arrivals,
+        # so two unplaced requests can be in hand at once.
+        self._holdover: "collections.deque[_Request]" = collections.deque()
         self._state = _init_state(
             cfg, n_slots, cache_len, params["norm"].dtype,
             num_blocks, self.block_size,
@@ -555,6 +731,21 @@ class ContinuousEngine:
         stats["blocks_free"] = self._pool.free_blocks
         return stats
 
+    def scheduler_stats(self) -> dict:
+        """Preemption/chunking accounting for /metrics: monotonic
+        preempt/resume/chunk counters (the server converts them by
+        delta at scrape time) plus the instantaneous chunk-queue and
+        parked-row depths. Lockless reads, same torn-read tolerance as
+        stats_summary — a scrape must never stall behind an admit
+        compile."""
+        return {
+            "preempted": self.preempted_total,
+            "resumed": self.resumed_total,
+            "chunks": self.chunks_total,
+            "chunk_queue": len(self._prefills),
+            "parked": len(self._parked),
+        }
+
     def _note(self, kind: str, **detail) -> None:
         """Flight-recorder entry with queue depth + pool occupancy
         observed NOW. Callable from any thread: qsize and the pool
@@ -580,15 +771,16 @@ class ContinuousEngine:
         only — NodeState.to_dict embeds it verbatim."""
         prof = self.profiler.summary(window_s=window_s)
         kv = self.kv_cache_stats()
-        # lockless holdover peek: the engine lock is held across admit
-        # jit compiles (potentially tens of seconds) and a heartbeat
-        # must never stall behind one; a torn read here only skews
-        # queue_depth by 1 for one sample
-        holdover = self._holdover is not None
+        # lockless holdover/parked peeks: the engine lock is held across
+        # admit jit compiles (potentially tens of seconds) and a
+        # heartbeat must never stall behind one; a torn read here only
+        # skews queue_depth by 1 for one sample. Parked rows count as
+        # waiting — they hold no slot and need a readmit to progress.
+        waiting = len(self._holdover) + len(self._parked)
         lookups = kv["hits"] + kv["misses"]
         return {
             "n_slots": self.n_slots,
-            "queue_depth": self._queue.qsize() + (1 if holdover else 0),
+            "queue_depth": self._queue.qsize() + waiting,
             "batch_occupancy": round(prof["batch_occupancy"], 6),
             "goodput_tokens_per_sec": round(
                 prof["goodput_tokens_per_sec"], 6
@@ -663,11 +855,19 @@ class ContinuousEngine:
 
     def _fail_inflight(self) -> None:
         """Fail over every published in-flight request (slots, live
-        group, holdover) — shared by stop() and the scheduler loop's
-        epilogue; all handoff fields are swapped under the lock."""
+        group, holdover, parked rows, chunked prefills) — shared by
+        stop() and the scheduler loop's epilogue; all handoff fields
+        are swapped under the lock."""
         failed = 0
         with self._lock:
-            holdover, self._holdover = self._holdover, None
+            held = list(self._holdover)
+            self._holdover.clear()
+            parked, self._parked = self._parked, []
+            # chunked-prefill tasks' requests are already published in
+            # _slot_req (the slot is reserved at plan time), so the
+            # slot sweep below releases them; only the task list needs
+            # clearing so a mid-compile chunk cannot be re-dispatched
+            self._prefills.clear()
             group, self._spec_group = self._spec_group, None
             for slot, req in enumerate(self._slot_req):
                 if req is not None:
@@ -675,9 +875,15 @@ class ContinuousEngine:
                     req.failed = "engine stopped mid-generation"
                     req.done.set()
                     failed += 1
-        if holdover is not None:
+        for holdover in held:
             holdover.failed = "engine stopped before the request was served"
             holdover.done.set()
+            failed += 1
+        for req in parked:
+            # parked requests carry partial output: fail, never return
+            # a truncated token list as a normal completion
+            req.failed = "engine stopped mid-generation"
+            req.done.set()
             failed += 1
         if group is not None:
             for req in group[0]:
@@ -699,17 +905,22 @@ class ContinuousEngine:
 
     # -- scheduler loop ---------------------------------------------------
 
-    def _plan_kv(self, req: "_Request"):
+    def _plan_kv(self, tokens: list[int], max_new: int):
         """Host-side paged-admit plan: radix match → capacity clamp →
-        evict/alloc. Returns ``(table_row, own_mask, reuse, total)`` —
-        the static-shape operands ``_admit_slot`` needs — or None when
-        the pool cannot supply the fresh blocks (admission
-        backpressure; unreachable with the __init__ sizing floor but
-        kept for custom pools). On success the slot holds one reference
-        per block in ``table_row[:total]``."""
-        p = len(req.prompt)
+        evict/alloc. ``tokens`` is the EFFECTIVE prompt — the original
+        prompt for a fresh admit, prompt + generated-so-far for a
+        parked readmit (whose park inserted those full blocks into the
+        trie, so the match below recovers them with zero recompute) —
+        and ``max_new`` the REMAINING budget, so the block horizon is
+        identical across preemptions. Returns ``(table_row, own_mask,
+        reuse, total)`` — the static-shape operands ``_admit_slot``
+        needs — or None when the pool cannot supply the fresh blocks
+        (admission backpressure; unreachable with the __init__ sizing
+        floor but kept for custom pools). On success the slot holds one
+        reference per block in ``table_row[:total]``."""
+        p = len(tokens)
         bs = self.block_size
-        matched = self._radix.match(req.prompt)  # +1 ref each, ours now
+        matched = self._radix.match(tokens)  # +1 ref each, ours now
         # full blocks only, and never the whole prompt: the last token
         # must be recomputed so the admit has logits to sample from
         reuse = min(len(matched), (p - 1) // bs)
@@ -724,13 +935,20 @@ class ContinuousEngine:
         if reuse < len(matched):
             self._pool.unref(matched[reuse:])
         shared = matched[:reuse]
-        total = -(-(p + req.max_new) // bs)  # ceil; fits() bounds it
+        total = -(-(p + max_new) // bs)  # ceil; fits() bounds it
         ev_before = self._radix.stats()["evictions"]
         if not self._radix.ensure_free(total - reuse):
             if shared:
                 self._pool.unref(shared)
+            # the fail-fast precheck (kv_blocks.ensure_free) means this
+            # fires WITHOUT stripping the trie when the shortfall is
+            # structural; the detail says which case the post-mortem is
+            # looking at (free+evictable < need = pinned by live rows)
             self._note("backpressure", prompt_tokens=p,
-                       need_blocks=total - reuse)
+                       need_blocks=total - reuse,
+                       free_blocks=self._pool.free_blocks,
+                       evictable_blocks=self._radix.evictable_blocks(),
+                       reason="pool pinned beyond eviction reach")
             return None
         evicted = self._radix.stats()["evictions"] - ev_before
         if evicted:
@@ -744,23 +962,147 @@ class ContinuousEngine:
         own_mask[reuse:total] = True
         return table_row, own_mask, reuse, total
 
-    def _admit(self, slot: int, req: _Request, kv_plan) -> None:
+    def _admit(self, slot: int, req: _Request, kv_plan,
+               tokens: list[int]) -> None:
+        """Reserve ``slot`` for ``req`` and start its prefill. With
+        chunking enabled and a long novel suffix, only a task is queued
+        — ``_step_prefill`` dispatches one chunk per scheduler pass so
+        decode steps interleave; otherwise (short suffix, chunking off)
+        the whole suffix goes through ``_finalize_admit`` in one
+        dispatch, exactly the pre-chunking admit."""
         table_row, own_mask, reuse, total = kv_plan
-        p = len(req.prompt)
-        start = reuse * self.block_size
-        suffix_len = p - start
-        req.t_admit = tracing.now()
-        _TRACER.record_span(
-            "engine.queue_wait", start=req.t_submit, end=req.t_admit,
-            parent=req.trace_parent, slot=slot,
+        resumed = bool(req.out_tokens)
+        if not resumed:
+            # first admission only: a readmit is not a queue exit (the
+            # request's TTFT clock kept running while parked — it
+            # already has tokens)
+            req.t_admit = tracing.now()
+            _TRACER.record_span(
+                "engine.queue_wait", start=req.t_submit, end=req.t_admit,
+                parent=req.trace_parent, slot=slot,
+            )
+            if self._slo is not None:
+                self._slo.observe(
+                    "queue_wait", req.t_admit - req.t_submit,
+                    t=req.t_admit,
+                )
+        self._slot_req[slot] = req
+        self._slot_blocks[slot] = [int(b) for b in table_row[:total]]
+        req.tokens_at_admit = len(req.out_tokens)
+        task = _PrefillTask(
+            req=req, slot=slot, table_row=table_row, own_mask=own_mask,
+            reuse=reuse, total=total, pos=reuse * self.block_size,
+            tokens=tokens, resumed=resumed,
         )
-        T = _bucket(suffix_len)  # _plan_kv guarantees start + T <= cache_len
+        if self._next_chunk_len(task) is not None:
+            self._prefills.append(task)
+            return
+        self._finalize_admit(task)
+
+    def _next_chunk_len(self, task: _PrefillTask) -> int | None:
+        """Chunk width for ``task``'s next dispatch, or None when the
+        remaining suffix should finalize through ``_admit_slot``. A
+        chunk is taken only while the POST-chunk tail still pads to a
+        canonical bucket that fits the logical row — otherwise the
+        final suffix is simply taken larger (still a canonical bucket,
+        so the compile-shape set stays {C} ∪ prefill buckets)."""
+        C = self.chunk_tokens
+        if not C:
+            return None
+        rem = len(task.tokens) - task.pos
+        if rem <= C:
+            return None
+        if task.pos + C + _bucket(rem - C) > self.cache_len:
+            return None
+        return C
+
+    def _step_prefill(self) -> None:
+        """Advance the oldest chunked prefill by AT MOST one dispatch —
+        the scheduler's pass quantum, so a long cold prompt never
+        blocks the decode batch for more than one chunk's latency
+        (Sarathi-SC's stall-free schedule, PAPERS.md)."""
+        with self._lock:
+            task = self._prefills[0] if self._prefills else None
+        if task is None:
+            return
+        if task.req.cancelled.is_set():
+            with self._lock:
+                if self._prefills and self._prefills[0] is task:
+                    self._prefills.pop(0)
+                    self._abort_prefill(task)
+            return
+        C = self._next_chunk_len(task)
+        if C is None:
+            with self._lock:
+                if not self._prefills or self._prefills[0] is not task:
+                    return  # stop() cleared the queue mid-pass
+                self._prefills.pop(0)
+                self._finalize_admit(task)
+            return
+        window = np.asarray(
+            task.tokens[task.pos:task.pos + C], np.int32
+        )[None]
+        t0 = tracing.now()
+        # device work outside the lock (first chunk of a width pays its
+        # compile; stop() must still be able to fail the slots)
+        # lint: allow[lock-discipline] scheduler thread is the only _state writer; see _loop
+        self._state = _prefill_chunk(
+            self.params, self._state, jnp.asarray(window),
+            jnp.int32(task.pos), self.cfg,
+            jnp.asarray(task.table_row), jnp.asarray(task.own_mask),
+        )
+        task.pos += C
+        self.chunks_total += 1
+        t1 = tracing.now()
+        with self._lock:
+            live_rows = sum(1 for r in self._slot_req if r is not None)
+        # every chunk token is live prompt work — no bucket padding by
+        # construction (intermediate chunks are exactly C tokens)
+        self.profiler.record(
+            "chunk", bucket=C, live_rows=live_rows,
+            live_tokens=C, padded_tokens=0, start=t0, end=t1,
+        )
+        self._note("chunk", slot=task.slot, pos=task.pos,
+                   prompt_tokens=len(task.tokens))
+
+    def _abort_prefill(self, task: _PrefillTask) -> None:
+        """Drop a cancelled mid-chunk prefill (caller holds the lock).
+        The row was never activated — its table is still all-null and
+        ``active`` False — so releasing the block holds is the whole
+        cleanup; no device state to touch."""
+        slot, req = task.slot, task.req
+        self._slot_req[slot] = None
+        blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+        if blocks:
+            self._pool.unref(blocks)
+        req.t_done = tracing.now()
+        self._note("retire", slot=slot, tokens=len(req.out_tokens),
+                   freed_blocks=len(blocks), cancelled=True)
+        req.done.set()
+
+    def _finalize_admit(self, task: _PrefillTask) -> None:
+        """Prefill the remaining suffix, sample the next token, and
+        flip the slot live — one ``_admit_slot`` dispatch (caller holds
+        the lock). For a resumed request the suffix counter equals the
+        uninterrupted run's decode counter at the same position
+        (_admit_slot folds prompt_len == original prompt + generated;
+        _decode_step folds offset + 1), so preempted and uninterrupted
+        runs draw identical sampling noise — the token-identity
+        invariant the preemption tests pin."""
+        req, slot, tokens = task.req, task.slot, task.tokens
+        reuse, total = task.reuse, task.total
+        p = len(tokens)
+        start = task.pos
+        suffix_len = p - start
+        t0 = tracing.now()
+        T = _bucket(suffix_len)  # _next_chunk_len kept start + T fitting
         padded = np.zeros((1, T), np.int32)
-        padded[0, :suffix_len] = req.prompt[start:]
-        # full-prompt id set computed host-side: the jit only sees the
-        # suffix, but repetition penalty must cover reused tokens too
+        padded[0, :suffix_len] = tokens[start:]
+        # full effective-prompt id set computed host-side: the jit only
+        # sees the suffix, but repetition penalty must cover reused and
+        # pre-preemption tokens too
         seen_row = np.zeros((1, self.cfg.vocab_size), bool)
-        seen_row[0, np.asarray(req.prompt, np.int64)] = True
+        seen_row[0, np.asarray(tokens, np.int64)] = True
         # explicit impl: _sample_rows wraps with threefry2x32 and
         # SlotState.rng is u32[B, 2]; deriving from the default-impl
         # PRNGKey would break under jax_default_prng_impl=rbg (u32[4])
@@ -771,45 +1113,60 @@ class ContinuousEngine:
             self.params, self._state, jnp.asarray(padded),
             jnp.int32(suffix_len), jnp.int32(start), jnp.int32(p),
             self.cfg, jnp.int32(slot),
-            jnp.asarray(table_row), jnp.asarray(own_mask),
+            jnp.asarray(task.table_row), jnp.asarray(task.own_mask),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
             jnp.float32(req.top_p), jnp.float32(req.rep_penalty), key_data,
             jnp.asarray(seen_row),
         )
-        self._slot_req[slot] = req
-        self._slot_blocks[slot] = [int(b) for b in table_row[:total]]
-        # cache the prompt's FULL blocks for later admits — including
-        # this one's fresh prefix blocks (their KV is committed by the
-        # scatter above; the partial tail block stays private)
+        # cache the effective prompt's FULL blocks for later admits —
+        # including this one's fresh blocks (their KV is committed by
+        # the scatter above; the partial tail block stays private)
         full = p // self.block_size
         if full:
-            self._radix.insert(req.prompt, [int(b) for b in table_row[:full]])
-        # the prefill already produced the first generated token
+            self._radix.insert(
+                tokens, [int(b) for b in task.table_row[:full]]
+            )
+        # the prefill already produced the next generated token
         # lint: allow[host-sync] admission boundary: the first token must reach the request result now
         first = int(self._state.last_token[slot])
         req.out_tokens.append(first)
-        req.t_first = tracing.now()
-        req.token_times.append(req.t_first)
-        # one profiler record per prefill dispatch: t_admit -> t_first
-        # brackets the _admit_slot call + its host sync above. The
-        # prefill's one live token is the sampled first token; the
-        # padding waste is the bucket tail (T - suffix_len) the static
-        # shapes force us to compute.
+        now = tracing.now()
+        req.token_times.append(now)
+        if not task.resumed:
+            req.t_first = now
+        # one profiler record per prefill dispatch, bracketing the
+        # _admit_slot call + its host sync above. The dispatch's one
+        # live token is the sampled token; the padding waste is the
+        # bucket tail (T - suffix_len) the static shapes force us to
+        # compute.
         live_rows = sum(1 for r in self._slot_req if r is not None)
         self.profiler.record(
             "prefill", bucket=T, live_rows=live_rows,
             live_tokens=suffix_len, padded_tokens=T - suffix_len,
-            start=req.t_admit, end=req.t_first,
+            start=t0, end=now,
         )
-        self._note("admit", slot=slot, suffix_bucket=T,
-                   reuse_blocks=reuse, total_blocks=total)
+        if task.resumed:
+            self.resumed_total += 1
+            self._note("resume", slot=slot, suffix_bucket=T,
+                       reuse_blocks=reuse, total_blocks=total,
+                       preemptions=req.preemptions)
+        else:
+            self._note("admit", slot=slot, suffix_bucket=T,
+                       reuse_blocks=reuse, total_blocks=total)
+        # span start: a FRESH admission's prefill phase begins at
+        # t_admit — exactly where engine.queue_wait ends (the serving
+        # breakdown is contiguous by construction, and with chunking
+        # the intermediate chunk dispatches belong inside the prefill
+        # phase). A readmit never exited a queue, so its span brackets
+        # just the finalize dispatch.
         sp = _TRACER.start_span(
-            "engine.prefill", parent=req.trace_parent, start=req.t_admit,
-            slot=slot, prompt_tokens=len(req.prompt), bucket=T,
-            reused_tokens=start, prefix_hit=reuse > 0,
+            "engine.prefill", parent=req.trace_parent,
+            start=t0 if task.resumed else req.t_admit,
+            slot=slot, prompt_tokens=p, bucket=T,
+            reused_tokens=reuse * self.block_size, prefix_hit=reuse > 0,
         )
-        sp.event("first-token", ts=req.t_first)
-        _TRACER.finish(sp, end=req.t_first)
+        sp.event("first-token", ts=now)
+        _TRACER.finish(sp, end=now)
         self._maybe_retire(slot)
 
     def _maybe_retire(self, slot: int) -> None:
@@ -853,6 +1210,117 @@ class ContinuousEngine:
                 sp.event("token", ts=ts, i=i)
             _TRACER.finish(sp, end=req.t_done)
             req.done.set()
+
+    # -- preemptive scheduling --------------------------------------------
+
+    def _park_slot(self, slot: int) -> None:
+        """Preempt a decoding row: bump its committed full blocks into
+        the radix trie (the trie's own +1 reference), release every
+        slot hold, and free the slot. The readmit later radix-matches
+        those exact blocks, so an unevicted park costs only the partial
+        tail block's recompute — vLLM preempts by recomputing the WHOLE
+        sequence; the trie is what makes parking nearly free here.
+        Parked blocks sit at trie-only refcount 1, i.e. they stay LRU-
+        evictable: a parked row can never pin the pool (eviction only
+        degrades its resume toward a colder admit, never correctness).
+        Caller holds the engine lock; lock order engine→radix→pool is
+        preserved through the insert/unref below."""
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        toks = req.prompt + req.out_tokens
+        blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+        # the LAST generated token's KV is not committed yet (the next
+        # decode step would have written it at the row's offset), so
+        # only blocks fully inside [0, len-1) may enter the trie — a
+        # block-aligned park would otherwise cache a block whose final
+        # position is junk, poisoning every later content-addressed
+        # match of it (the readmit itself recomputes the tail, but a
+        # LONGER continuation would reuse the poisoned block verbatim)
+        committed = toks[:-1]
+        full = len(committed) // self.block_size
+        if full:
+            self._radix.insert(committed, blocks[:full])
+        self._slot_req[slot] = None
+        if blocks:
+            self._pool.unref(blocks)
+        self._state = dataclasses.replace(
+            self._state,
+            active=self._state.active.at[slot].set(False),
+            # all-null BEFORE the next decode scatter: freed blocks may
+            # be re-issued to another slot, and a stale table would
+            # keep writing into them
+            tables=self._state.tables.at[slot].set(0),
+        )
+        req.t_parked = tracing.now()
+        req.preemptions += 1
+        self.preempted_total += 1
+        self._parked.append(req)
+        self._note("preempt", slot=slot, tokens=len(req.out_tokens),
+                   cached_blocks=full, parked=len(self._parked))
+
+    def _pick_victim(self, pol: PreemptionPolicy) -> int | None:
+        """Lowest-priority preemptable row: the YOUNGEST-arrival active
+        decoding slot (LIFO victim order keeps the oldest work running,
+        matching the longest-pending-first admission order) that has
+        decoded at least ``min_progress`` tokens since its own
+        (re)admission and whose cold readmit would still fit a slot.
+        Mid-prefill rows are never parked — their KV is half-committed
+        and they produced nothing to cache. Caller holds the lock."""
+        prefilling = {t.slot for t in self._prefills}
+        victim, victim_t = None, -1.0
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot in prefilling:
+                continue
+            if len(req.out_tokens) - req.tokens_at_admit < \
+                    max(1, pol.min_progress):
+                continue
+            # a parked row readmits with effective prompt = prompt +
+            # generated; if the trie got evicted meanwhile the resume
+            # is COLD, so the full bucket must still fit the row
+            if _bucket(len(req.prompt) + len(req.out_tokens)) > \
+                    self.cache_len:
+                continue
+            if req.t_submit > victim_t:
+                victim, victim_t = slot, req.t_submit
+        return victim
+
+    def _maybe_preempt(self) -> None:
+        """Park one decoding row for the longest-pending waiter when
+        queue-wait pressure crosses the policy's burn-rate threshold.
+        At most one preemption per call, gated by the cooldown — the
+        scheduler never mass-evicts its own batch."""
+        pol = self.preemption
+        if pol is None or self._slo is None:
+            return
+        with self._lock:
+            waiter = self._holdover[0] if self._holdover else None
+            free = any(r is None for r in self._slot_req)
+        if waiter is None or free:
+            return
+        now = tracing.now()
+        wait = now - waiter.pending_since
+        if wait < pol.threshold_s or \
+                self._steps_since_preempt < pol.cooldown_steps:
+            return
+        # feed the live head-wait in: a fully wedged engine admits
+        # nothing, so admit-time observations alone would never show
+        # the burn rising exactly when preemption is needed most
+        self._slo.observe("queue_wait", wait, t=now)
+        burn = max(self._slo.burn_rates(now=now)["queue_wait"].values())
+        if burn < pol.burn_limit:
+            return
+        with self._lock:
+            victim = self._pick_victim(pol)
+            if victim is None:
+                return
+            self._park_slot(victim)
+        self._steps_since_preempt = 0
+        # admit the waiter into the freed slot NOW — the parked victim
+        # re-enters the pending order behind it (pending_since just
+        # reset), so each preemption transfers the slot to strictly
+        # older work
+        self._admit_pending()
 
     def _drain_spec_group(
         self, first: "_Request"
@@ -1008,17 +1476,23 @@ class ContinuousEngine:
             r.done.set()
 
     def _place(self, req: "_Request") -> bool:
-        """Route one arrival: draft group if eligible and none is live,
-        else a free slot; False stashes it as the holdover (all slots
-        busy). Caller must NOT hold the lock."""
+        """Route one pending request: draft group if eligible and none
+        is live, else a free slot; False stashes it back at the front
+        of the holdover (all slots busy). Resumed requests never join
+        draft groups — their generated prefix lives in the paged pool,
+        which the speculative engine cannot see. Caller must NOT hold
+        the lock."""
         if req.cancelled.is_set():
+            req.t_done = tracing.now()
             req.done.set()
             return True
+        resumed = bool(req.out_tokens)
         with self._lock:
             group_free = self._spec_group is None
         if (
             self.speculative is not None
             and group_free
+            and not resumed
             and req.rep_penalty == 1.0
             and self.speculative.fits(len(req.prompt), req.max_new)
         ):
@@ -1026,30 +1500,57 @@ class ContinuousEngine:
             self._start_spec_group(group)
             if holdover is not None:
                 with self._lock:
-                    self._holdover = holdover
+                    # freshly drained from the queue = newest pending
+                    self._holdover.append(holdover)
             return True
         with self._lock:
             for slot in range(self.n_slots):
                 if self._slot_req[slot] is None:
-                    kv_plan = self._plan_kv(req)
+                    tokens = req.prompt + req.out_tokens
+                    kv_plan = self._plan_kv(
+                        tokens, req.max_new - len(req.out_tokens)
+                    )
                     if kv_plan is None:
                         break  # pool backpressure: hold until a retire
-                    self._admit(slot, req, kv_plan)
+                    self._admit(slot, req, kv_plan, tokens)
                     return True
-            self._holdover = req
+            # front, not back: this was the oldest pending request and
+            # must stay first in line
+            self._holdover.appendleft(req)
         return False
 
-    def _admit_pending(self) -> None:
-        """Place the holdover and queued arrivals until something has to
-        wait (all slots busy and the arrival is not group-eligible)."""
-        while True:
-            with self._lock:
-                req, self._holdover = self._holdover, None
-            if req is None:
+    def _pop_pending(self) -> "_Request | None":
+        """Longest-pending-first admission order across the three
+        waiting populations: the holdover deque, the parked list, and
+        the arrival queue (pulled through the holdover so its head's
+        age is comparable). This order is the anti-livelock guarantee:
+        a just-parked victim's ``pending_since`` restarts at its park
+        time, so it can never preempt-loop ahead of the waiter it was
+        parked for."""
+        with self._lock:
+            if not self._holdover:
                 try:
-                    req = self._queue.get_nowait()
+                    self._holdover.append(self._queue.get_nowait())
                 except queue.Empty:
-                    return
+                    pass
+            hold = self._holdover[0] if self._holdover else None
+            park = self._parked[0] if self._parked else None
+            if park is not None and (
+                hold is None or park.pending_since <= hold.pending_since
+            ):
+                return self._parked.pop(0)
+            if hold is not None:
+                return self._holdover.popleft()
+            return None
+
+    def _admit_pending(self) -> None:
+        """Place pending requests (parked readmits and arrivals, oldest
+        first) until something has to wait — all slots busy, or pool
+        backpressure."""
+        while True:
+            req = self._pop_pending()
+            if req is None:
+                return
             if not self._place(req):
                 return
 
@@ -1057,8 +1558,9 @@ class ContinuousEngine:
         while not self._stop.is_set():
             with self._lock:
                 busy = any(r is not None for r in self._slot_req)
-                idle = not busy and self._spec_group is None
-                have_holdover = self._holdover is not None
+                idle = (not busy and self._spec_group is None
+                        and not self._parked)
+                have_holdover = bool(self._holdover)
             if idle:
                 # fully idle: block briefly for the next arrival
                 if not have_holdover:
@@ -1067,17 +1569,29 @@ class ContinuousEngine:
                     except queue.Empty:
                         continue
                     with self._lock:
-                        self._holdover = nxt
+                        self._holdover.append(nxt)
                 self._admit_pending()
                 continue
-            # live work: non-blocking admissions, then one step of each
-            # active machine — a busy slot batch and a live draft group
-            # advance in lockstep (one decode step / one speculation
-            # round per loop pass), so neither starves the other
+            # live work: non-blocking admissions, a preemption check
+            # when the waiters' SLO pressure warrants one, then one
+            # step of each active machine — the decode batch, at most
+            # ONE prefill chunk, and a live draft group advance in
+            # lockstep per loop pass, so none starves the others. This
+            # interleave is the tentpole: prefill stopped being one
+            # atomic dispatch and became schedulable work competing
+            # with decode under an explicit policy.
             self._admit_pending()
+            self._maybe_preempt()
             with self._lock:
-                live_rows = sum(1 for r in self._slot_req if r is not None)
-            if live_rows:
+                # mid-prefill rows are reserved but not yet decoding
+                # (active=False, null tables); they are padding in the
+                # decode dispatch, not live rows
+                prefilling = {t.slot for t in self._prefills}
+                decode_rows = sum(
+                    1 for s, r in enumerate(self._slot_req)
+                    if r is not None and s not in prefilling
+                )
+            if decode_rows:
                 # device step outside the lock (it can block on a
                 # compile; stop() must still be able to fail the slots)
                 step_t0 = tracing.now()
@@ -1093,11 +1607,12 @@ class ContinuousEngine:
                 # decode dispatch is always the full n_slots-wide batch
                 # (static shapes): inactive rows are pure padding
                 self.profiler.record(
-                    "decode", bucket=self.n_slots, live_rows=live_rows,
-                    live_tokens=live_rows,
-                    padded_tokens=self.n_slots - live_rows,
+                    "decode", bucket=self.n_slots, live_rows=decode_rows,
+                    live_tokens=decode_rows,
+                    padded_tokens=self.n_slots - decode_rows,
                     start=step_t0, end=step_t,
                 )
+                self._steps_since_preempt += 1
                 with self._lock:
                     for slot in range(self.n_slots):
                         req = self._slot_req[slot]
@@ -1105,6 +1620,7 @@ class ContinuousEngine:
                             req.out_tokens.append(int(toks[slot]))
                             req.token_times.append(step_t)
                             self._maybe_retire(slot)
+            self._step_prefill()  # at most one chunk per pass
             self._step_spec_group()  # locked no-op when no group is live
         # epilogue: anything published after stop()'s sweep (admission
         # was mid-compile during the snapshot) is released here — the

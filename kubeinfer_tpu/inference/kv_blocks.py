@@ -239,11 +239,40 @@ class RadixCache:
         self.evictions += 1
         return True
 
+    def evictable_blocks(self) -> int:
+        """Upper bound on blocks eviction could reclaim right now:
+        trie nodes whose block only the trie holds (pool refcount 1).
+        It is an overestimate when a refcount-1 interior node sits above
+        a pinned child (that subtree path cannot be fully torn down),
+        but an overestimate only delays the fail-fast below to the first
+        stuck ``_evict_one`` — it never rejects a servable request."""
+        with self._lock:
+            return self._evictable_locked()
+
+    def _evictable_locked(self) -> int:
+        count = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not self._root and self._pool.refcount(node.block) == 1:
+                count += 1
+        return count
+
     def ensure_free(self, n: int) -> bool:
         """Evict LRU-first until the pool has n free blocks. False when
-        eviction cannot get there (everything live is pinned by slots)
-        — the engine treats that as admission backpressure."""
+        eviction cannot get there (everything live is pinned by slots or
+        parked rows) — the engine treats that as admission backpressure.
+
+        Fails fast BEFORE evicting anything when free + evictable can
+        never reach n: under preemption pressure a hopeless request used
+        to strip the entire reusable cache on its way to False, turning
+        one backpressured admit into a cold-start penalty for every
+        later warm admit. The loop itself always terminates — each
+        successful ``_evict_one`` frees exactly one block."""
         with self._lock:
+            if n > self._pool.free_blocks + self._evictable_locked():
+                return False
             while self._pool.free_blocks < n:
                 if not self._evict_one():
                     return False

@@ -192,6 +192,38 @@ def _serving_metrics(registry: Registry):
             "shape (jit compile proxy)",
             registry=registry,
         ),
+        # preemptive scheduling + chunked prefill (batching.py): the
+        # monotonic engine counters convert by delta at scrape time
+        # like the radix counters above; the depth gauges snapshot the
+        # scheduler's instantaneous backlog
+        "preemptions": Counter(
+            "kubeinfer_preemptions_total",
+            "Decoding rows parked (blocks cached to the radix trie) to "
+            "admit an SLO-pressured waiter",
+            registry=registry,
+        ),
+        "resumes": Counter(
+            "kubeinfer_preemption_resumes_total",
+            "Parked rows readmitted (radix warm-resume)",
+            registry=registry,
+        ),
+        "chunks": Counter(
+            "kubeinfer_prefill_chunks_total",
+            "Intermediate chunked-prefill dispatches (excludes the "
+            "finalizing bucket dispatch)",
+            registry=registry,
+        ),
+        "chunk_queue": Gauge(
+            "kubeinfer_prefill_chunk_queue_depth",
+            "Chunked prefills in flight (slot reserved, row not yet "
+            "decoding)",
+            registry=registry,
+        ),
+        "parked": Gauge(
+            "kubeinfer_parked_requests",
+            "Preempted requests awaiting readmission",
+            registry=registry,
+        ),
         # SLO burn rates (observability/slo.py): burn 1.0 = spending
         # budget exactly at the sustainable rate; the window label keeps
         # the short/long pair an alerting rule needs in one series
@@ -399,6 +431,9 @@ class InferenceServer:
         self.metrics["occupancy"].set(summary["batch_occupancy"])
         self.metrics["padding_waste"].set(summary["padding_waste_frac"])
         self.metrics["queue_depth"].set(summary["queue_depth"])
+        sched = self.continuous.scheduler_stats()
+        self.metrics["chunk_queue"].set(sched["chunk_queue"])
+        self.metrics["parked"].set(sched["parked"])
         with self._kv_lock:
             for key, name in (
                 ("hits", "prefix_hits"),
@@ -412,6 +447,17 @@ class InferenceServer:
                 # its first event
                 self.metrics[name].inc(by=delta)
                 self._kv_last[key] = stats[key]
+            # scheduler counters ride the same delta-to-Counter
+            # conversion (the engine's ints are monotonic per process;
+            # _kv_last keys are disjoint from the radix ones)
+            for key, name in (
+                ("preempted", "preemptions"),
+                ("resumed", "resumes"),
+                ("chunks", "chunks"),
+            ):
+                delta = sched[key] - self._kv_last.get(key, 0)
+                self.metrics[name].inc(by=delta)
+                self._kv_last[key] = sched[key]
             # profiler replay under the same lock: the cursor advance
             # and the histogram observes must be atomic per scrape or a
             # concurrent scrape double-counts the same step records
@@ -690,6 +736,18 @@ def main(argv: list[str] | None = None) -> int:
                         "concurrent requests, greedy and sampled alike "
                         "(0 disables; over-slot-width requests use the "
                         "per-request engine)")
+    p.add_argument("--prefill-chunk-blocks", type=int, default=4,
+                   help="split each prefill into chunks of this many KV "
+                        "blocks interleaved with decode steps, so a long "
+                        "cold prompt never stalls the decode batch for "
+                        "more than one chunk (0 = whole-suffix prefill)")
+    p.add_argument("--preemption-slo", default="",
+                   metavar="THRESHOLD_S[:BURN_LIMIT]",
+                   help="park the youngest decoding row (KV cached to "
+                        "the radix trie, token-identical warm resume) "
+                        "when a waiter exceeds THRESHOLD_S and the "
+                        "queue-wait burn rate reaches BURN_LIMIT "
+                        "(default 1.0); empty disables preemption")
     p.add_argument("--draft-model", default="",
                    help="draft model dir (HF snapshot) or preset name "
                         "(with --random-init) enabling speculative "
@@ -805,12 +863,19 @@ def main(argv: list[str] | None = None) -> int:
         )
     continuous = None
     if args.batch_slots > 0:
-        from kubeinfer_tpu.inference.batching import ContinuousEngine
+        from kubeinfer_tpu.inference.batching import (
+            ContinuousEngine, PreemptionPolicy,
+        )
 
+        preemption = None
+        if args.preemption_slo:
+            preemption = PreemptionPolicy.parse(args.preemption_slo)
         continuous = ContinuousEngine(
             params, cfg, n_slots=args.batch_slots,
             cache_len=min(max_cache, 4096),
             speculative=speculative,
+            prefill_chunk_blocks=args.prefill_chunk_blocks,
+            preemption=preemption,
         )
         if args.prewarm_spec and speculative is not None:
             sizes = tuple(

@@ -29,6 +29,7 @@ __all__ = ["FlightEvent", "FlightRecorder"]
 # and tests can enumerate the kinds
 KINDS = (
     "submit", "admit", "retire", "evict", "backpressure", "fail_inflight",
+    "preempt", "resume", "chunk",
 )
 
 

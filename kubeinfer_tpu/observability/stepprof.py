@@ -37,7 +37,7 @@ from kubeinfer_tpu.observability import tracing
 
 __all__ = ["StepRecord", "StepProfiler"]
 
-PHASES = ("prefill", "decode", "spec")
+PHASES = ("prefill", "decode", "spec", "chunk")
 
 
 @dataclass(frozen=True)
@@ -46,7 +46,7 @@ class StepRecord:
 
     seq: int  # monotonic dispatch index (scrape cursors key on it)
     t: float  # dispatch end, tracing-clock seconds
-    phase: str  # "prefill" | "decode" | "spec"
+    phase: str  # "prefill" | "decode" | "spec" | "chunk"
     bucket: int  # compiled-shape knob: suffix bucket / batch width
     live_rows: int  # rows carrying a real request
     n_slots: int  # batch capacity the dispatch was padded to

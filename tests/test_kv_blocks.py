@@ -146,6 +146,27 @@ class TestRadixCache:
         pool.unref(blocks)
         assert cache.ensure_free(3)
 
+    def test_ensure_free_fail_fast_preserves_cache(self):
+        # hopeless requests must be refused BEFORE eviction starts: the
+        # old loop stripped every evictable node on its way to False,
+        # turning one backpressured admit into a cold start for every
+        # later warm admit
+        pool = BlockPool(num_blocks=6, block_size=4)
+        cache = RadixCache(pool)
+        pinned = pool.alloc(2)
+        cache.insert(list(range(8)), pinned)  # slot + trie: refcount 2
+        self._cached(cache, pool, list(range(100, 108)))  # evictable
+        assert pool.free_blocks == 1
+        # free(1) + evictable(2) < 4 → immediate refusal, zero evictions
+        assert not cache.ensure_free(4)
+        assert cache.stats()["evictions"] == 0
+        m = cache.match(list(range(100, 108)))
+        assert len(m) == 2  # the reusable cache survived the refusal
+        pool.unref(m)
+        # a request eviction CAN satisfy still goes through
+        assert cache.ensure_free(3)
+        assert cache.stats()["evictions"] == 2
+
     def test_hit_miss_counters(self):
         pool = BlockPool(num_blocks=4, block_size=4)
         cache = RadixCache(pool)
