@@ -834,6 +834,158 @@ def serving_slo_bench(n_slots=4, cache_len=1024, model="bench-280m",
     }
 
 
+def fleet_routing_bench(n_replicas=3, families=6, per_family=4,
+                        prefix_len=256, tail=8, max_new=4,
+                        model="bench-280m", seed=17):
+    """Fleet-routing phase (prefix-cache-aware router PR): does routing
+    on advertised radix summaries beat cache-blind round-robin?
+
+    Three in-process replica servers share one set of weights but own
+    separate paged KV pools, each sized at the pool's minimum
+    (``1 + n_slots * max_blocks``): two prefix families fit in one
+    replica's trie, the full six cannot. The workload is a seeded,
+    shuffled mix over six shared-prefix families — shuffled so family
+    order never aligns with the round-robin modulus and hands RR
+    accidental affinity. Both policies start from the SAME divergent
+    steady state (families planted round-robin across replicas, which
+    is just what serving traffic produces on its own) and replay the
+    same request list sequentially:
+
+    - routed: through ``RouterServer.forward`` after one
+      ``/cache/summary`` poll — requests follow their family's blocks,
+      so prefill is the 8-token suffix bucket;
+    - round-robin: directly to replica ``i % n``, so 2/3 of requests
+      miss AND every miss's insert evicts another family's LRU blocks,
+      keeping the misses coming (the thrash regime small pools live in).
+
+    TTFT comes from the replica's own ``kubeinfer.ttft_ms`` response
+    stamp (queue-wait + prefill, the serving breakdown's definition) so
+    proxy/HTTP overhead is excluded from BOTH sides and the delta is
+    purely cache locality. Sequential issue keeps queue-wait ~0 and the
+    comparison deterministic. CPU-pinned like every serving phase (the
+    docstrings above say why).
+    """
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.inference.engine import Engine
+    from kubeinfer_tpu.inference.server import InferenceServer
+    from kubeinfer_tpu.router import FleetRouter, RouterServer
+
+    cfg = PRESETS[model]
+    rng = np.random.default_rng(seed)
+    block_size, cache_len, n_slots = 32, 512, 2
+    num_blocks = 1 + n_slots * (cache_len // block_size)
+    prefixes = [
+        rng.integers(0, cfg.vocab_size, prefix_len).tolist()
+        for _ in range(families)
+    ]
+    mix = [f for f in range(families) for _ in range(per_family)]
+    rng.shuffle(mix)
+    requests = [
+        prefixes[f] + rng.integers(0, cfg.vocab_size, tail).tolist()
+        for f in mix
+    ]
+    warm = rng.integers(0, cfg.vocab_size, prefix_len + tail).tolist()
+    warm2 = warm[:prefix_len] + rng.integers(
+        0, cfg.vocab_size, tail
+    ).tolist()
+
+    def post(port, prompt):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps(
+                {"prompt": prompt, "max_tokens": max_new}
+            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    def mk_fleet():
+        fleet = []
+        for i in range(n_replicas):
+            cont = ContinuousEngine(
+                params, cfg, n_slots=n_slots, cache_len=cache_len,
+                block_size=block_size, num_blocks=num_blocks,
+            ).start()
+            srv = InferenceServer(
+                Engine(params, cfg), model_id=f"r{i}", port=0,
+                continuous=cont,
+            ).start()
+            fleet.append((srv, cont))
+        # warm the cold-admit (prefix_len+tail) and warm-suffix admit
+        # buckets + decode before anything is measured; the jit cache is
+        # process-global, so one replica warms shapes for all of them
+        post(fleet[0][0].port, warm)
+        post(fleet[0][0].port, warm2)
+        _touch_progress()
+        # the divergent-cache steady state both policies start from:
+        # families planted round-robin, two per replica
+        for f, prefix in enumerate(prefixes):
+            post(fleet[f % n_replicas][0].port, prefix)
+            _touch_progress()
+        return fleet
+
+    def stop_fleet(fleet):
+        for srv, cont in fleet:
+            srv.stop()
+            cont.stop()
+
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+
+        fleet = mk_fleet()
+        router = FleetRouter()
+        for i, (srv, _) in enumerate(fleet):
+            router.add_replica(f"r{i}", f"http://127.0.0.1:{srv.port}")
+        rs = RouterServer(router)  # forward() driven directly, no listener
+        try:
+            rs.poll_once()
+            routed = []
+            for prompt in requests:
+                code, payload = rs.forward(json.dumps(
+                    {"prompt": prompt, "max_tokens": max_new}
+                ).encode())
+                if code != 200:
+                    raise RuntimeError(f"routed request failed: {code}")
+                routed.append(
+                    json.loads(payload)["kubeinfer"]["ttft_ms"]
+                )
+                _touch_progress()
+            hit_rate = router.affinity_hit_rate
+        finally:
+            rs.stop()
+            stop_fleet(fleet)
+
+        fleet = mk_fleet()
+        try:
+            rr = []
+            for i, prompt in enumerate(requests):
+                doc = post(fleet[i % n_replicas][0].port, prompt)
+                rr.append(doc["kubeinfer"]["ttft_ms"])
+                _touch_progress()
+        finally:
+            stop_fleet(fleet)
+    finally:
+        jax.config.update("jax_default_device", prev_dev)
+    return {
+        "ttft_ms_p50_routed": round(statistics.median(routed), 3),
+        "ttft_ms_p50_roundrobin": round(statistics.median(rr), 3),
+        "router_affinity_hit_rate": round(hit_rate, 3),
+        "fleet_replicas": n_replicas,
+        "fleet_mix_seed": seed,
+    }
+
+
 _last_progress = [0.0]
 
 
@@ -1245,6 +1397,20 @@ def main() -> None:
                 extras[key] = slo[key]
         except Exception as e:
             extras["serving_slo_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # fleet-routing phase (prefix-cache-aware router PR): p50 TTFT
+        # through the summary-scoring router vs cache-blind round-robin
+        # over the same planted 3-replica fleet and seeded request mix
+        try:
+            fr = fleet_routing_bench()
+            for key in (
+                "ttft_ms_p50_routed", "ttft_ms_p50_roundrobin",
+                "router_affinity_hit_rate", "fleet_replicas",
+                "fleet_mix_seed",
+            ):
+                extras[key] = fr[key]
+        except Exception as e:
+            extras["fleet_routing_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
 
     print(

@@ -38,6 +38,7 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
 )
 from kubeinfer_tpu.coordination.lease import LeaseManager
+from kubeinfer_tpu.inference.kv_blocks import SUMMARY_FINGERPRINT_BUDGET
 from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
@@ -59,6 +60,32 @@ def model_cache_dir(root: str, model_repo: str) -> str:
     """Node-local cache dir for a model; replicas of the same model on one
     node share it (that sharing IS the cache the reference builds)."""
     return str(pathlib.Path(root) / model_repo.replace("/", "--"))
+
+
+def _clamp_serving_stats(serving: dict) -> dict:
+    """Cap the heartbeat's servingStats payload.
+
+    The engine's stats_summary already truncates its cache summary at
+    kv_blocks.SUMMARY_FINGERPRINT_BUDGET, but the callback is
+    injectable (tests, future runtimes) and every NodeState write lands
+    in the store — a misbehaving callback must not turn the 1/s
+    heartbeat into multi-megabyte store churn. The clamp re-truncates
+    the fingerprint list in place-of (never mutating the caller's dict)
+    and is deterministic: the list is already hottest-first ordered by
+    the producer, so keeping a prefix keeps the hottest paths."""
+    summary = serving.get("cache_summary")
+    if not isinstance(summary, dict):
+        return serving
+    fps = summary.get("fingerprints")
+    if not isinstance(fps, list) or len(fps) <= SUMMARY_FINGERPRINT_BUDGET:
+        return serving
+    out = dict(serving)
+    out["cache_summary"] = dict(
+        summary,
+        fingerprints=fps[:SUMMARY_FINGERPRINT_BUDGET],
+        truncated=True,
+    )
+    return out
 
 
 class ReplicaAgent:
@@ -476,7 +503,7 @@ class NodeAgent:
             # a flaky stats callback must never cost the heartbeat —
             # liveness signal beats load telemetry
             try:
-                serving = self._serving_stats() or {}
+                serving = _clamp_serving_stats(self._serving_stats() or {})
             except Exception:  # noqa: BLE001
                 log.exception("serving_stats callback failed; "
                               "heartbeating without stats")

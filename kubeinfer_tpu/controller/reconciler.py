@@ -41,6 +41,7 @@ from kubeinfer_tpu.controlplane.store import (
     Store,
 )
 from kubeinfer_tpu.observability import tracing
+from kubeinfer_tpu.router import scoring
 from kubeinfer_tpu.scheduler import SolveRequest, get_backend
 from kubeinfer_tpu.solver.problem import GIB, MAX_MODELS
 from kubeinfer_tpu.utils.clock import Clock, RealClock
@@ -274,8 +275,23 @@ class Controller:
             # Lookup-only (no registration): a cached model no job in this
             # batch references gives no affinity signal, and registering it
             # would burn table slots needed by later job models.
+            #
+            # Queue-pressure gate (ROADMAP item 4): placement and the
+            # fleet router optimize the same objective — prefix/cache
+            # affinity minus queue pressure (router/scoring.py). The
+            # solver's affinity channel is a bitmap, so the router's
+            # continuous score quantizes here to "affine unless
+            # drowning": a node whose serving replica reports a queue
+            # at least PRESSURE_AFFINITY_CUTOFF queues-per-slot deep
+            # loses its cache pull and stops attracting MORE replicas
+            # exactly when the router would stop sending it requests.
+            # Capacity/feasibility is untouched — a drowning node can
+            # still be chosen when nothing else fits.
             cached = np.zeros((len(nodes), MAX_MODELS), np.uint8)
             for i, n in enumerate(nodes):
+                pressure = scoring.queue_pressure(n.serving_stats)
+                if pressure >= scoring.PRESSURE_AFFINITY_CUTOFF:
+                    continue
                 for m in n.cached_models:
                     s = model_table.get(m)
                     if s:

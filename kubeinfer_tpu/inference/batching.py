@@ -731,6 +731,14 @@ class ContinuousEngine:
         stats["blocks_free"] = self._pool.free_blocks
         return stats
 
+    def cache_summary(self) -> dict:
+        """Capped radix-summary advertisement (fingerprints + version)
+        for the fleet router — served at the inference server's
+        ``/cache/summary`` and embedded in stats_summary for the
+        heartbeat path. Callable from any thread; the trie takes its
+        own lock."""
+        return self._radix.summary()
+
     def scheduler_stats(self) -> dict:
         """Preemption/chunking accounting for /metrics: monotonic
         preempt/resume/chunk counters (the server converts them by
@@ -780,6 +788,7 @@ class ContinuousEngine:
         lookups = kv["hits"] + kv["misses"]
         return {
             "n_slots": self.n_slots,
+            "block_size": self.block_size,
             "queue_depth": self._queue.qsize() + waiting,
             "batch_occupancy": round(prof["batch_occupancy"], 6),
             "goodput_tokens_per_sec": round(
@@ -791,6 +800,12 @@ class ContinuousEngine:
             "prefix_hit_rate": round(
                 kv["hits"] / lookups if lookups else 0.0, 6
             ),
+            "prefix_cached_tokens": kv["cached_tokens"],
+            # the router's prefix-affinity signal, already capped at
+            # kv_blocks.SUMMARY_FINGERPRINT_BUDGET so a big trie cannot
+            # bloat the store write this dict rides in (the node agent
+            # re-clamps defensively — the callback is injectable)
+            "cache_summary": self._radix.summary(),
         }
 
     def prewarm_spec(self, group_sizes: tuple[int, ...] = (1,),

@@ -36,6 +36,61 @@ from kubeinfer_tpu.analysis.racecheck import make_lock
 
 NULL_BLOCK = 0
 
+# -- path fingerprints --------------------------------------------------
+#
+# The fleet router (kubeinfer_tpu/router/) scores replicas by longest
+# advertised prefix match without ever shipping token ids across the
+# control plane: each trie node carries a rolling hash of the block-key
+# path from the root, and RadixCache.summary() exports a capped set of
+# those fingerprints. The request side recomputes the same chain over
+# its own prompt (prefix_fingerprints) and the deepest fingerprint
+# present in a replica's advertised set IS the match depth. Both sides
+# must use the identical chain function, which is why it lives here and
+# the router imports it — two implementations would silently drift.
+#
+# The hash is FNV-1a folded per token and chained per block, masked to
+# 63 bits so fingerprints survive JSON round-trips (store heartbeats)
+# as plain positive ints. Collisions only misroute a request to a
+# replica that turns out cold — a performance blip, never a
+# correctness issue — so 63 bits is plenty. Deliberately NOT Python's
+# hash(): that is salted per process and two replicas would never agree.
+
+_FP_SEED = 0xCBF29CE484222325 & ((1 << 63) - 1)  # FNV-1a offset basis
+_FP_PRIME = 0x100000001B3
+_FP_MASK = (1 << 63) - 1
+
+# Heartbeat payload cap: a trie can grow to thousands of nodes, and the
+# summary rides inside every NodeState heartbeat (agent -> store write,
+# typically 1/s per node). 512 fingerprints is ~10 KiB of JSON — small
+# next to the rest of NodeState, yet deep enough to advertise hundreds
+# of distinct prefix families. Truncation keeps the LRU-newest (hottest)
+# paths, so what gets dropped is exactly what the cache would evict
+# first anyway; a truncated summary only understates match depth.
+SUMMARY_FINGERPRINT_BUDGET = 512
+
+
+def extend_fingerprint(fp: int, key: Sequence[int]) -> int:
+    """Chain one block of token ids onto a path fingerprint."""
+    h = fp
+    for t in key:
+        h = ((h ^ (int(t) & _FP_MASK)) * _FP_PRIME) & _FP_MASK
+    return h
+
+
+def prefix_fingerprints(tokens: Sequence[int], block_size: int) -> list[int]:
+    """Fingerprint of every full-block prefix of ``tokens``,
+    shallowest first — element i covers tokens[0 : (i+1)*block_size].
+    The partial tail block is never fingerprinted, mirroring the trie's
+    full-blocks-only keying (the tail is copy-on-write, never shared)."""
+    if block_size <= 0:
+        raise ValueError(f"block_size must be > 0, got {block_size}")
+    out: list[int] = []
+    fp = _FP_SEED
+    for i in range(0, len(tokens) - block_size + 1, block_size):
+        fp = extend_fingerprint(fp, tokens[i:i + block_size])
+        out.append(fp)
+    return out
+
 
 class BlockPool:
     """Fixed-size pool of KV blocks with host-side refcounts.
@@ -119,7 +174,7 @@ class _Node:
     """One trie edge = one full block of tokens. The node holds the
     pool block storing that span's KV (trie's own +1 reference)."""
 
-    __slots__ = ("children", "parent", "key", "block", "stamp")
+    __slots__ = ("children", "parent", "key", "block", "stamp", "fp")
 
     def __init__(self, parent: "_Node | None", key: tuple | None,
                  block: int) -> None:
@@ -128,6 +183,13 @@ class _Node:
         self.key = key
         self.block = block
         self.stamp = 0
+        # path fingerprint root->here, extended incrementally so insert
+        # stays O(block_size) per new node instead of re-hashing the
+        # whole path
+        self.fp = (
+            _FP_SEED if parent is None
+            else extend_fingerprint(parent.fp, key)
+        )
 
 
 class RadixCache:
@@ -151,6 +213,10 @@ class RadixCache:
         self._root = _Node(None, None, NULL_BLOCK)
         self._clock = 0  # monotonic LRU stamp; touched on every match
         self._nodes = 0
+        # summary version: bumps whenever the advertised fingerprint set
+        # can have changed (insert created nodes / eviction removed one)
+        # so routers can diff summaries by a single int compare
+        self._version = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -202,6 +268,8 @@ class RadixCache:
                     created += 1
                 child.stamp = self._clock
                 node = child
+            if created:
+                self._version += 1
         return created
 
     def note_result(self, reused_blocks: int) -> None:
@@ -237,6 +305,7 @@ class RadixCache:
         self._pool.unref([victim.block])
         self._nodes -= 1
         self.evictions += 1
+        self._version += 1
         return True
 
     def evictable_blocks(self) -> int:
@@ -279,10 +348,54 @@ class RadixCache:
             return True
 
     def stats(self) -> dict:
+        """Counters plus trie shape. ``nodes``/``leaves`` and
+        ``cached_tokens`` (= nodes x block_size, every edge is exactly
+        one full block) are the capacity denominators a summary
+        consumer needs to judge how much of the trie its capped
+        fingerprint set actually covers."""
         with self._lock:
+            leaves = 0
+            stack = list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                if node.children:
+                    stack.extend(node.children.values())
+                else:
+                    leaves += 1
             return {
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "nodes": self._nodes,
+                "leaves": leaves,
+                "cached_tokens": self._nodes * self._pool.block_size,
+            }
+
+    def summary(self, budget: int = SUMMARY_FINGERPRINT_BUDGET) -> dict:
+        """Compact advertisement of what this cache holds, for the
+        fleet router: every cached path's fingerprint (hottest first,
+        capped at ``budget``), the block size the request side must use
+        to recompute matching fingerprints, and a version that bumps on
+        any insert/evict so consumers can skip unchanged summaries.
+
+        Truncation order is deterministic: LRU stamp descending (the
+        paths the cache would keep longest advertise first), fingerprint
+        as the tie-break so equal-stamp nodes — e.g. a whole path
+        inserted by one admit — never reorder between two exports of
+        the same trie. ``total_nodes`` lets a consumer see HOW MUCH was
+        dropped, not just whether (``truncated``)."""
+        with self._lock:
+            entries: list[tuple[int, int]] = []
+            stack = list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                entries.append((node.stamp, node.fp))
+            entries.sort(key=lambda e: (-e[0], e[1]))
+            return {
+                "version": self._version,
+                "block_size": self._pool.block_size,
+                "total_nodes": len(entries),
+                "truncated": len(entries) > budget,
+                "fingerprints": [fp for _, fp in entries[:budget]],
             }

@@ -319,6 +319,19 @@ class InferenceServer:
                     self.respond(
                         200, "application/json", json.dumps(doc),
                     )
+                elif path == "/cache/summary":
+                    # unauthenticated like /metrics: fingerprints are
+                    # one-way hashes of block keys — no prompt content
+                    # is recoverable — and the fleet router polls this
+                    # from inside the pod network
+                    serving = (
+                        server.continuous.stats_summary()
+                        if server.continuous is not None else {}
+                    )
+                    self.respond(200, "application/json", json.dumps({
+                        "model": server.model_id,
+                        "serving": serving,
+                    }))
                 elif path == "/debug/flightrecorder":
                     fl = (server.continuous.flight.to_dict()
                           if server.continuous is not None
@@ -519,20 +532,29 @@ class InferenceServer:
         self.metrics["completion_tokens"].inc(
             by=resp["usage"]["completion_tokens"]
         )
-        self._observe_breakdown(
+        ttft = self._observe_breakdown(
             route, dur, resp["usage"]["completion_tokens"],
             route_box.get("timing"),
         )
+        # non-OpenAI extension: the serving timeline as the SERVER saw
+        # it. The fleet router/bench compare replicas by TTFT, and a
+        # client-side wall clock would fold proxy+network time into the
+        # very signal being compared.
+        resp["kubeinfer"] = {
+            "route": route,
+            "ttft_ms": round(ttft * 1e3, 3),
+        }
         return resp
 
     def _observe_breakdown(self, route: str, total_s: float, n_out: int,
-                           req=None) -> None:
+                           req=None) -> float:
         """Derived latency-breakdown histograms. The continuous route
         hands back its ``_Request`` (``timing`` in the route box) whose
         t_submit/t_admit/t_first/t_done were stamped by the scheduler
         itself; routes without an internal timeline degrade to
         end-to-end TTFT and mean-per-token TPOT — the route label keeps
-        the populations separable on dashboards."""
+        the populations separable on dashboards. Returns the observed
+        TTFT (seconds) so complete() can echo it to the client."""
         ttft = total_s
         decode_s = None
         if req is not None and req.t_submit:
@@ -554,6 +576,7 @@ class InferenceServer:
             tpot = total_s / max(1, n_out)
         self.metrics["tpot"].observe(route, tpot)
         self.slo.observe("tpot", tpot)
+        return ttft
 
     def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")

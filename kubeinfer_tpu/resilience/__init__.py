@@ -262,6 +262,21 @@ class CircuitBreaker:
             breaker_transitions_total.inc(self.edge, to)
             breaker_state.set(self.edge, _STATE_CODE[to])
 
+    def peek(self) -> bool:
+        """Would ``allow()`` plausibly admit a call right now?
+        Read-only: neither transitions open -> half-open nor consumes
+        the half-open probe slot. Candidacy filters (the fleet router's
+        scoring loop) use this — calling ``allow()`` from a filter
+        would burn the single probe on a replica the filter may not
+        even choose, and an unconsumed probe wedges the breaker
+        half-open forever."""
+        with self._mu:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                return self._clock() - self._opened_at >= self._reset
+            return not self._probing
+
     def allow(self) -> bool:
         with self._mu:
             if self._state == "closed":

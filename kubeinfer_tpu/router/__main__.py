@@ -1,0 +1,5 @@
+"""``python -m kubeinfer_tpu.router`` — fleet router CLI."""
+
+from kubeinfer_tpu.router.server import main
+
+raise SystemExit(main())

@@ -1,0 +1,331 @@
+"""Routing brain: replica views, scoring, and the route decision.
+
+State model (SGLang-router style, approximate-then-correct): the router
+keeps a LOCAL view of every replica's radix cache — refreshed
+authoritatively from ``/cache/summary`` polls or store ``NodeState``
+heartbeats, and extended OPTIMISTICALLY after each routed request (the
+blocks this request just prefilled will be in that replica's trie well
+before the next refresh). Optimism can only overstate a match, and an
+overstated match costs one cold prefill on the replica that was going
+to serve the request anyway — so the view is allowed to be wrong in
+exactly the direction that is cheap.
+
+Transport lives in router.server; nothing here opens a socket, which is
+what lets unit tests and the reconciler share this logic verbatim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.inference.kv_blocks import (
+    SUMMARY_FINGERPRINT_BUDGET,
+    prefix_fingerprints,
+)
+from kubeinfer_tpu.metrics.registry import Counter, Gauge, Registry
+from kubeinfer_tpu.observability import tracing
+from kubeinfer_tpu.resilience import CircuitBreaker, faultpoints
+from kubeinfer_tpu.router import scoring
+
+_TRACER = tracing.get_tracer("router")
+
+# Optimistic inserts are uncapped growth if a replica never confirms
+# them; past this the view stops absorbing guesses until the next
+# authoritative refresh resets the set.
+_OPTIMISTIC_CAP = 4 * SUMMARY_FINGERPRINT_BUDGET
+
+
+class NoReplicaError(RuntimeError):
+    """Every known replica is dead, breaker-open, or excluded."""
+
+
+def _router_metrics(registry: Registry) -> dict:
+    """Per-router collector set (one Registry per router instance, same
+    pattern as the inference server's _serving_metrics — module-level
+    collectors would cross-pollute multi-router tests and bench)."""
+    return {
+        "requests": Counter(
+            "kubeinfer_router_requests_total",
+            "Requests proxied, by upstream replica and outcome",
+            labels=("replica", "outcome"), registry=registry,
+        ),
+        "routed": Counter(
+            "kubeinfer_router_routed_total",
+            "Routing decisions, by chosen replica and reason "
+            "(affinity = positive prefix match; fallback = least-loaded)",
+            labels=("replica", "reason"), registry=registry,
+        ),
+        "affinity_hits": Counter(
+            "kubeinfer_router_affinity_hits_total",
+            "Decisions where the chosen replica advertised a prefix match",
+            registry=registry,
+        ),
+        "affinity_misses": Counter(
+            "kubeinfer_router_affinity_misses_total",
+            "Decisions that fell back to least-loaded (no match anywhere)",
+            registry=registry,
+        ),
+        "affinity_ratio": Gauge(
+            "kubeinfer_router_affinity_hit_ratio",
+            "affinity_hits / decisions since start",
+            registry=registry,
+        ),
+        "skipped": Counter(
+            "kubeinfer_router_replicas_skipped_total",
+            "Replicas excluded from a decision's candidate set "
+            "(breaker = circuit open; dead = signal older than the TTL; "
+            "failed = transport failure earlier in this same request)",
+            labels=("replica", "reason"), registry=registry,
+        ),
+        "replicas": Gauge(
+            "kubeinfer_router_replicas",
+            "Known replicas by liveness at the last decision",
+            labels=("state",), registry=registry,
+        ),
+    }
+
+
+@dataclass
+class ReplicaView:
+    """What the router believes about one replica."""
+
+    name: str
+    url: str
+    fingerprints: set = field(default_factory=set)
+    version: int = -1
+    block_size: int = 0
+    serving: dict = field(default_factory=dict)
+    last_seen: float = float("-inf")  # router-clock time of last signal
+    breaker: CircuitBreaker | None = None
+
+
+@dataclass(frozen=True)
+class RouteDecision:
+    replica: str
+    url: str
+    match_blocks: int
+    match_tokens: int
+    pressure: float
+    score: float
+    stale: bool
+    fallback: bool  # no replica had a positive match
+    candidates: int  # how many replicas were scored
+
+
+class FleetRouter:
+    """Scores replicas for each request; owns the replica views."""
+
+    def __init__(
+        self,
+        alpha: float = scoring.ALPHA_QUEUE_BLOCKS,
+        stale_after_s: float = scoring.STALE_AFTER_S,
+        dead_after_s: float = scoring.DEAD_AFTER_S,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+        registry: Registry | None = None,
+    ) -> None:
+        self.alpha = alpha
+        self.stale_after_s = stale_after_s
+        self.dead_after_s = dead_after_s
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._clock = clock
+        self.registry = registry if registry is not None else Registry()
+        self.metrics = _router_metrics(self.registry)
+        self._lock = make_lock("router.FleetRouter._lock")
+        self._replicas: dict[str, ReplicaView] = {}
+        self._decisions = 0
+        self._hits = 0
+
+    # -- view maintenance ---------------------------------------------------
+
+    def add_replica(self, name: str, url: str) -> ReplicaView:
+        """Register (or re-register) a replica endpoint. Known names
+        keep their view — re-adding after a restart preserves breaker
+        history, which is what makes the half-open probe meaningful."""
+        with self._lock:
+            view = self._replicas.get(name)
+            if view is None:
+                view = ReplicaView(
+                    name=name, url=url.rstrip("/"),
+                    breaker=CircuitBreaker(
+                        edge=f"router.proxy[{name}]",
+                        failure_threshold=self._breaker_threshold,
+                        reset_timeout_s=self._breaker_reset_s,
+                        clock=self._clock,
+                    ),
+                )
+                self._replicas[name] = view
+            else:
+                view.url = url.rstrip("/")
+            return view
+
+    def update_replica(self, name: str, serving: dict | None,
+                       age_s: float = 0.0) -> None:
+        """Authoritative refresh from a ``/cache/summary`` body's
+        ``serving`` dict or a ``NodeState.serving_stats``. ``age_s``
+        back-dates the signal (store mode: now - heartbeat) so
+        staleness accounting works across clock domains. Replaces the
+        fingerprint set wholesale — optimistic guesses the replica
+        never confirmed die here, which is the correction half of the
+        approximate-then-correct contract."""
+        serving = serving if isinstance(serving, dict) else {}
+        summary = serving.get("cache_summary")
+        with self._lock:
+            view = self._replicas.get(name)
+            if view is None:
+                return
+            view.serving = serving
+            view.last_seen = self._clock() - max(0.0, age_s)
+            if isinstance(summary, dict):
+                fps = summary.get("fingerprints")
+                if isinstance(fps, list):
+                    view.fingerprints = set(fps)
+                view.version = int(summary.get("version", view.version))
+                view.block_size = int(
+                    summary.get("block_size", view.block_size) or 0
+                )
+
+    def update_from_nodestates(self, states: Sequence, now: float) -> None:
+        """Store-fed refresh: one pass over listed ``NodeState``
+        objects. ``now`` is the store's wall clock (the same one that
+        stamped the heartbeats); only replicas previously registered by
+        name get updated — the store advertises no port, so endpoint
+        registration stays explicit."""
+        for s in states:
+            if not getattr(s, "ready", False):
+                continue
+            hb = getattr(s, "heartbeat", 0.0)
+            age = max(0.0, now - hb) if hb else 0.0
+            self.update_replica(
+                s.metadata.name, getattr(s, "serving_stats", None), age_s=age,
+            )
+
+    def note_routed(self, decision: RouteDecision,
+                    tokens: Sequence[int]) -> None:
+        """Optimistic insert after a successfully proxied request: the
+        chosen replica's trie now holds this prompt's full blocks."""
+        with self._lock:
+            view = self._replicas.get(decision.replica)
+            if view is None or not view.block_size:
+                return
+            if len(view.fingerprints) >= _OPTIMISTIC_CAP:
+                return
+            view.fingerprints.update(
+                prefix_fingerprints(tokens, view.block_size)
+            )
+
+    def replicas(self) -> list[ReplicaView]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- the decision -------------------------------------------------------
+
+    def route(self, tokens: Sequence[int],
+              exclude: frozenset | set = frozenset()) -> RouteDecision:
+        """Score every eligible replica and pick the argmax.
+
+        ``exclude`` names replicas that already failed THIS request
+        (the proxy retries across replicas); they count as skipped with
+        reason=failed. Ties break by replica name so two routers fed
+        identical views agree — useful for replayable chaos runs.
+        """
+        faultpoints.fire("router.route")
+        with _TRACER.span("router.route") as span:
+            decision = self._route_locked(tokens, exclude)
+            span.set(
+                replica=decision.replica,
+                match_blocks=decision.match_blocks,
+                pressure=round(decision.pressure, 4),
+                score=round(decision.score, 4),
+                fallback=decision.fallback,
+                candidates=decision.candidates,
+            )
+            return decision
+
+    def _route_locked(self, tokens: Sequence[int],
+                      exclude: frozenset | set) -> RouteDecision:
+        now = self._clock()
+        fps_by_bs: dict[int, list[int]] = {}
+        counts = {"alive": 0, "stale": 0, "dead": 0}
+        best: tuple[float, str] | None = None
+        best_info: RouteDecision | None = None
+        n_scored = 0
+        with self._lock:
+            views = list(self._replicas.values())
+        for view in views:
+            if view.name in exclude:
+                self.metrics["skipped"].inc(view.name, "failed")
+                continue
+            age = now - view.last_seen
+            if age > self.dead_after_s:
+                counts["dead"] += 1
+                self.metrics["skipped"].inc(view.name, "dead")
+                continue
+            # peek, never allow(): candidacy must not consume the
+            # half-open probe slot of a replica this decision may not
+            # choose — the proxy's RetryPolicy is the one consumer
+            if view.breaker is not None and not view.breaker.peek():
+                self.metrics["skipped"].inc(view.name, "breaker")
+                continue
+            stale = age > self.stale_after_s
+            counts["stale" if stale else "alive"] += 1
+            bs = view.block_size
+            if bs and bs not in fps_by_bs:
+                fps_by_bs[bs] = prefix_fingerprints(tokens, bs)
+            match = (
+                scoring.match_depth(fps_by_bs[bs], view.fingerprints)
+                if bs else 0
+            )
+            pressure = scoring.queue_pressure(view.serving)
+            score = scoring.replica_score(
+                match, pressure, stale, alpha=self.alpha
+            )
+            n_scored += 1
+            key = (score, view.name)
+            # name ascending on equal score: (score, name) compared so
+            # that HIGHER score wins but LOWER name wins ties
+            if best is None or score > best[0] or (
+                score == best[0] and view.name < best[1]
+            ):
+                best = key
+                best_info = RouteDecision(
+                    replica=view.name, url=view.url,
+                    match_blocks=match, match_tokens=match * bs,
+                    pressure=pressure, score=score, stale=stale,
+                    fallback=False, candidates=0,
+                )
+        for state, n in counts.items():
+            self.metrics["replicas"].set(state, n)
+        if best_info is None:
+            raise NoReplicaError(
+                f"no routable replica ({len(views)} known, "
+                f"{len(exclude)} excluded this request)"
+            )
+        fallback = best_info.match_blocks == 0
+        decision = dataclasses.replace(
+            best_info, fallback=fallback, candidates=n_scored
+        )
+        with self._lock:
+            self._decisions += 1
+            if not fallback:
+                self._hits += 1
+            ratio = self._hits / self._decisions
+        if fallback:
+            self.metrics["affinity_misses"].inc()
+            self.metrics["routed"].inc(decision.replica, "fallback")
+        else:
+            self.metrics["affinity_hits"].inc()
+            self.metrics["routed"].inc(decision.replica, "affinity")
+        self.metrics["affinity_ratio"].set(ratio)
+        return decision
+
+    @property
+    def affinity_hit_rate(self) -> float:
+        with self._lock:
+            return self._hits / self._decisions if self._decisions else 0.0

@@ -1,0 +1,327 @@
+"""HTTP front door: proxy, summary poller, and CLI.
+
+One process, three loops:
+
+- ``RouterServer`` accepts OpenAI-style ``POST /v1/completions`` and
+  forwards the raw body to the replica ``FleetRouter.route`` picks,
+  under a per-replica RetryPolicy + CircuitBreaker. A transport
+  failure re-scores with the failed replica excluded — the request
+  only errors out when EVERY replica is unreachable, so one dead
+  replica degrades routing (colder caches, fewer candidates), never
+  correctness.
+- A poller thread refreshes each replica's view from
+  ``GET /cache/summary`` every ``poll_interval_s``. Store-fed
+  deployments skip the poller and call
+  ``FleetRouter.update_from_nodestates`` off a NodeState list instead;
+  both sources land in the same ``update_replica``.
+- ``/metrics`` renders the router's own registry (kubeinfer_router_*
+  plus the shared retry/breaker series its RetryPolicy feeds).
+
+The proxy retries only failures that prove the request never reached
+the replica (resilience.connect_failure): generation is deterministic
+per (prompt, seed, sampling), so a replay is token-identical, but a
+reset mid-response may have burned slot time — those surface to the
+client like any single-server error would.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import random
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+from kubeinfer_tpu.observability import tracing
+from kubeinfer_tpu.resilience import RetryPolicy, connect_failure, faultpoints
+from kubeinfer_tpu.router.core import FleetRouter, NoReplicaError
+from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, inject_traceparent
+
+log = logging.getLogger(__name__)
+
+_TRACER = tracing.get_tracer("router")
+
+# One connect-failure retry per replica before re-scoring elsewhere:
+# the cross-replica loop is the real retry budget, and burning a full
+# backoff schedule on a dead replica just adds tail latency before the
+# router does the thing it exists to do (route around it).
+_PROXY_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.05, max_delay_s=0.2,
+    deadline_s=10.0, classify=connect_failure,
+)
+
+
+class RouterServer:
+    """Fleet front door over a FleetRouter."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0, poll_interval_s: float = 2.0,
+                 upstream_timeout_s: float = 300.0,
+                 rng: random.Random | None = None) -> None:
+        self.router = router
+        self.poll_interval_s = poll_interval_s
+        self.upstream_timeout_s = upstream_timeout_s
+        # seeded-injectable rng: chaos runs replay the retry jitter
+        self._rng = rng if rng is not None else random.Random()
+        self._stop = threading.Event()
+        self._poller: threading.Thread | None = None
+        server = self
+
+        class Handler(BaseEndpointHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/health":
+                    self.respond(200, "text/plain", "OK")
+                elif path == "/metrics":
+                    self.respond(
+                        200, "text/plain; version=0.0.4",
+                        server.router.registry.render(),
+                    )
+                elif path == "/replicas":
+                    self.respond(
+                        200, "application/json",
+                        json.dumps(server.replica_snapshot()),
+                    )
+                else:
+                    self.respond(404, "text/plain", "not found\n")
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                if path != "/v1/completions":
+                    self.respond(404, "text/plain", "not found\n")
+                    return
+                with _TRACER.span(
+                    "http POST /v1/completions",
+                    parent=self.trace_context(),
+                ) as sp:
+                    try:
+                        code, payload = server.forward(raw)
+                        sp.set(status=code)
+                        self.respond(code, "application/json", payload)
+                    except Exception as e:  # keep the thread alive
+                        log.exception("router forward failed")
+                        sp.set(status=502)
+                        self.respond(502, "application/json", json.dumps({
+                            "error": {"message": str(e),
+                                      "type": "router_error"},
+                        }))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # -- request path -------------------------------------------------------
+
+    def forward(self, raw_body: bytes) -> tuple[int, bytes]:
+        """Route + proxy one completions request; returns (status,
+        body) to relay verbatim (plus a routing annotation). Callable
+        without the HTTP listener — bench drives this directly."""
+        try:
+            body = json.loads(raw_body or b"{}")
+        except ValueError:
+            return 400, json.dumps({"error": {
+                "message": "request body is not JSON",
+                "type": "invalid_request_error"}}).encode()
+        prompt = body.get("prompt")
+        # only token-id prompts are scorable (the router has no
+        # tokenizer — by design, it must not need model assets); string
+        # prompts still route, degrading to least-loaded
+        tokens = (
+            prompt if isinstance(prompt, list)
+            and all(isinstance(t, int) for t in prompt) else []
+        )
+        tried: set[str] = set()
+        while True:
+            try:
+                decision = self.router.route(tokens, exclude=tried)
+            except NoReplicaError as e:
+                return 502, json.dumps({"error": {
+                    "message": str(e), "type": "no_replica"}}).encode()
+            try:
+                payload = self._proxy(decision, raw_body)
+            except urllib.error.HTTPError as e:
+                # the replica ANSWERED (4xx/5xx): relay its verdict —
+                # a validation error would fail identically anywhere
+                self.router.metrics["requests"].inc(
+                    decision.replica, f"http_{e.code}"
+                )
+                return e.code, e.read()
+            except Exception as e:  # noqa: BLE001 — transport failure
+                log.warning("replica %s unreachable (%s); re-scoring",
+                            decision.replica, type(e).__name__)
+                self.router.metrics["requests"].inc(
+                    decision.replica, "unreachable"
+                )
+                tried.add(decision.replica)
+                continue
+            self.router.metrics["requests"].inc(decision.replica, "ok")
+            if tokens:
+                self.router.note_routed(decision, tokens)
+            return 200, self._annotate(payload, decision)
+
+    def _proxy(self, decision, raw_body: bytes) -> bytes:
+        """One replica attempt under the per-replica retry policy and
+        breaker. The traceparent header carries the router's active
+        span, so the replica's server-side spans join this trace."""
+        view = next(
+            (v for v in self.router.replicas()
+             if v.name == decision.replica), None
+        )
+
+        def attempt() -> bytes:
+            faultpoints.fire("router.proxy", key=decision.replica)
+            req = urllib.request.Request(
+                decision.url + "/v1/completions",
+                data=raw_body,
+                headers=inject_traceparent(
+                    {"Content-Type": "application/json"}
+                ),
+                method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.upstream_timeout_s
+            ) as resp:
+                return resp.read()
+
+        return _PROXY_POLICY.call(
+            attempt,
+            edge="router.proxy",
+            breaker=view.breaker if view is not None else None,
+            rng=self._rng,
+        )
+
+    @staticmethod
+    def _annotate(payload: bytes, decision) -> bytes:
+        """Stamp the routing decision into the response's ``kubeinfer``
+        extension block so clients (and the chaos test) can see which
+        replica served and whether affinity hit."""
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            return payload
+        if not isinstance(doc, dict):
+            return payload
+        ext = doc.setdefault("kubeinfer", {})
+        ext["replica"] = decision.replica
+        ext["match_blocks"] = decision.match_blocks
+        ext["fallback"] = decision.fallback
+        return json.dumps(doc).encode()
+
+    # -- replica-state refresh ----------------------------------------------
+
+    def poll_once(self, timeout_s: float = 5.0) -> int:
+        """One authoritative refresh pass over every known replica;
+        returns how many answered. Unreachable replicas keep their
+        (aging) view — staleness scoring and the breaker handle them;
+        the poller never unregisters anything."""
+        ok = 0
+        for view in self.router.replicas():
+            try:
+                with urllib.request.urlopen(
+                    view.url + "/cache/summary", timeout=timeout_s
+                ) as resp:
+                    doc = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 — poller must outlive outages
+                continue
+            self.router.update_replica(view.name, doc.get("serving"))
+            ok += 1
+        return ok
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def replica_snapshot(self) -> list[dict]:
+        now = self.router._clock()
+        return [
+            {
+                "name": v.name,
+                "url": v.url,
+                "fingerprints": len(v.fingerprints),
+                "version": v.version,
+                "queue_depth": v.serving.get("queue_depth"),
+                "age_s": (
+                    round(now - v.last_seen, 3)
+                    if v.last_seen != float("-inf") else None
+                ),
+                "breaker": v.breaker.state if v.breaker else "none",
+            }
+            for v in self.router.replicas()
+        ]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, poll: bool = True) -> "RouterServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"router-server-{self.port}",
+        )
+        self._thread.start()
+        if poll and self.poll_interval_s > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True, name="router-poller",
+            )
+            self._poller.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="kubeinfer-router")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=URL", required=True,
+                   help="inference server endpoint, repeatable "
+                        "(e.g. r0=http://10.0.0.5:8000)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--alpha", type=float,
+                   default=None, help="queue-pressure weight in blocks "
+                   "(default: scoring.ALPHA_QUEUE_BLOCKS)")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="seconds between /cache/summary refreshes")
+    args = p.parse_args(argv)
+
+    from kubeinfer_tpu.router import scoring
+
+    router = FleetRouter(
+        alpha=args.alpha if args.alpha is not None
+        else scoring.ALPHA_QUEUE_BLOCKS,
+    )
+    for spec in args.replica:
+        name, _, url = spec.partition("=")
+        if not url:
+            p.error(f"--replica needs NAME=URL, got {spec!r}")
+        router.add_replica(name, url)
+    srv = RouterServer(router, host=args.host, port=args.port,
+                       poll_interval_s=args.poll_interval)
+    srv.poll_once()
+    srv.start()
+    log.info("router listening on :%d over %d replicas",
+             srv.port, len(router.replicas()))
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

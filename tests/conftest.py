@@ -80,6 +80,9 @@ _COMPONENT_BY_PREFIX = (
     (("test_chaos", "test_resilience"), "chaos"),
     # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/)
     (("test_static_analysis",), "analysis"),
+    # fleet router: scoring/summary round-trips + proxy; its chaos
+    # scenario carries an explicit @pytest.mark.chaos on top
+    (("test_router",), "router"),
     # tracing + serving latency breakdown (kubeinfer_tpu/observability/)
     (("test_observability",), "observability"),
 )

@@ -22,6 +22,7 @@ from kubeinfer_tpu.inference.kv_blocks import (
     NULL_BLOCK,
     BlockPool,
     RadixCache,
+    prefix_fingerprints,
 )
 
 TINY = PRESETS["tiny"]
@@ -174,6 +175,64 @@ class TestRadixCache:
         cache.note_result(2)
         s = cache.stats()
         assert (s["hits"], s["misses"]) == (1, 1)
+
+    def test_stats_shape_counts(self):
+        # nodes/leaves/cached_tokens are the summary's capacity
+        # denominators (how much trie a capped export covers)
+        pool = BlockPool(num_blocks=16, block_size=4)
+        cache = RadixCache(pool)
+        assert cache.stats()["leaves"] == 0
+        self._cached(cache, pool, list(range(12)))  # chain of 3
+        self._cached(cache, pool, [0, 1, 2, 3, 50, 51, 52, 53])  # fork at 1
+        s = cache.stats()
+        assert s["nodes"] == 4
+        assert s["leaves"] == 2  # two divergent tails
+        assert s["cached_tokens"] == 16
+
+    def test_summary_fingerprints_match_request_side(self):
+        # the router recomputes prefix fingerprints from raw tokens;
+        # every cached path prefix must be present in the export, and a
+        # divergent prompt must share exactly the common-prefix entries
+        pool = BlockPool(num_blocks=16, block_size=4)
+        cache = RadixCache(pool)
+        toks = list(range(12))
+        self._cached(cache, pool, toks)
+        adv = set(cache.summary()["fingerprints"])
+        assert set(prefix_fingerprints(toks + [99, 98], 4)) == adv
+        diverged = prefix_fingerprints([0, 1, 2, 3, 7, 7, 7, 7], 4)
+        assert diverged[0] in adv and diverged[1] not in adv
+
+    def test_summary_version_bumps_on_insert_and_evict(self):
+        pool = BlockPool(num_blocks=6, block_size=4)
+        cache = RadixCache(pool)
+        v0 = cache.summary()["version"]
+        self._cached(cache, pool, list(range(8)))
+        v1 = cache.summary()["version"]
+        assert v1 > v0
+        # warm re-insert creates nothing → version unchanged (routers
+        # diff by version; a no-op insert must not invalidate views)
+        held = cache.match(list(range(8)))
+        cache.insert(list(range(8)), held)
+        pool.unref(held)
+        assert cache.summary()["version"] == v1
+        assert cache.ensure_free(5)
+        assert cache.summary()["version"] > v1
+
+    def test_summary_truncation_keeps_hottest_deterministically(self):
+        pool = BlockPool(num_blocks=32, block_size=4)
+        cache = RadixCache(pool)
+        paths = [[100 * i + j for j in range(4)] for i in range(6)]
+        for p in paths:
+            self._cached(cache, pool, p)
+        # touch path 2 then path 4: they are now LRU-newest
+        pool.unref(cache.match(paths[2]))
+        pool.unref(cache.match(paths[4]))
+        s = cache.summary(budget=2)
+        assert s["truncated"] and s["total_nodes"] == 6
+        hot = {prefix_fingerprints(p, 4)[0] for p in (paths[2], paths[4])}
+        assert set(s["fingerprints"]) == hot
+        # same trie, same export — byte-for-byte
+        assert cache.summary(budget=2) == s
 
 
 class TestPagedEngine:

@@ -346,11 +346,19 @@ class TestEngineInstrumentation:
         engine.generate([9, 8, 7], max_new_tokens=2)
         s = engine.stats_summary()
         assert set(s) == {
-            "n_slots", "queue_depth", "batch_occupancy",
+            "n_slots", "block_size", "queue_depth", "batch_occupancy",
             "goodput_tokens_per_sec", "padding_waste_frac",
             "kv_blocks_free", "kv_blocks_in_use", "prefix_hit_rate",
+            "prefix_cached_tokens", "cache_summary",
         }
         assert s["n_slots"] == 2
+        # the router's affinity signal: fingerprints must round-trip
+        # JSON (63-bit masked) and stay within the advertised budget
+        summ = s["cache_summary"]
+        assert summ["block_size"] == s["block_size"]
+        assert len(summ["fingerprints"]) <= 512
+        assert all(0 <= fp < 2**63 for fp in summ["fingerprints"])
+        assert s["prefix_cached_tokens"] >= 0
         assert s["queue_depth"] == 0  # nothing in flight now
         assert 0.0 <= s["batch_occupancy"] <= 1.0
         assert 0.0 <= s["padding_waste_frac"] <= 1.0

@@ -30,7 +30,7 @@ def mk_service(name="svc", replicas=2, gpu=1, policy="jax-greedy", **spec_over):
     return svc
 
 
-def mk_node(name, gpu=8, mem_gib=64, cached=(), heartbeat=0.0):
+def mk_node(name, gpu=8, mem_gib=64, cached=(), heartbeat=0.0, serving=None):
     n = NodeState(
         gpu_capacity=gpu,
         gpu_free=gpu,
@@ -38,6 +38,7 @@ def mk_node(name, gpu=8, mem_gib=64, cached=(), heartbeat=0.0):
         gpu_memory_free_bytes=int(mem_gib * 2**30),
         cached_models=list(cached),
         heartbeat=heartbeat,
+        serving_stats=dict(serving or {}),
     )
     n.metadata.name = name
     return n
@@ -148,6 +149,28 @@ class TestPlacement:
         assert res.nodes == 1
         w = Workload.from_dict(store.get(Workload.KIND, "svc"))
         assert all(r.node == "fresh" for r in w.replicas)
+
+    def test_queue_pressure_gates_cache_affinity(self):
+        """Two nodes both advertise the model cached, but one's serving
+        replica is drowning (queue >= PRESSURE_AFFINITY_CUTOFF per
+        slot): its cache-affinity bit is gated off, so the idle cached
+        node is strictly preferred — placement stops feeding a node at
+        the same threshold the fleet router stops routing to it."""
+        store, clock, c = setup(n_nodes=0)
+        model = "org/svc-model"
+        store.create(NodeState.KIND, mk_node(
+            "node-hot", cached=(model,), heartbeat=95.0,
+            serving={"queue_depth": 8, "n_slots": 2},
+        ).to_dict())
+        store.create(NodeState.KIND, mk_node(
+            "node-idle", cached=(model,), heartbeat=95.0,
+            serving={"queue_depth": 0, "n_slots": 2},
+        ).to_dict())
+        store.create(LLMService.KIND, mk_service("svc", replicas=1).to_dict())
+        res = c.reconcile_once()
+        assert res.replicas_placed == 1
+        w = Workload.from_dict(store.get(Workload.KIND, "svc"))
+        assert w.replicas[0].node == "node-idle"
 
     def test_capacity_respected_across_services(self):
         store, clock, c = setup(n_nodes=1, gpu=4)

@@ -1,0 +1,469 @@
+"""Fleet router: scoring math, summary round-trips, the HTTP proxy,
+and the replica-death chaos scenario.
+
+Layering mirrors the package: scoring/ FleetRouter tests are pure
+(no sockets, simulated clock), the store round-trip drives the REAL
+heartbeat path (RadixCache -> stats_summary-shaped dict -> NodeAgent
+-> store -> NodeState -> router), and the HTTP tests stand up real
+inference servers on localhost — the same virtual CPU mesh every other
+serving test uses.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeinfer_tpu.agent import NodeAgent
+from kubeinfer_tpu.api.workload import NodeState
+from kubeinfer_tpu.controlplane import Store
+from kubeinfer_tpu.inference import PRESETS, init_params
+from kubeinfer_tpu.inference.batching import ContinuousEngine
+from kubeinfer_tpu.inference.engine import Engine
+from kubeinfer_tpu.inference.kv_blocks import (
+    SUMMARY_FINGERPRINT_BUDGET,
+    BlockPool,
+    RadixCache,
+    prefix_fingerprints,
+)
+from kubeinfer_tpu.inference.server import InferenceServer
+from kubeinfer_tpu.resilience.faultpoints import REGISTRY, FaultSpec
+from kubeinfer_tpu.router import (
+    FleetRouter,
+    NoReplicaError,
+    RouterServer,
+    scoring,
+)
+from kubeinfer_tpu.utils.clock import SimulatedClock
+
+TINY = PRESETS["tiny"]
+BS = 16  # block size shared by every engine here
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    REGISTRY.disarm()
+    REGISTRY.seed(42)
+    yield
+    REGISTRY.disarm()
+
+
+def summary_of(*paths: list[int], block_size: int = 4) -> dict:
+    """A real RadixCache summary holding the given token paths."""
+    pool = BlockPool(num_blocks=64, block_size=block_size)
+    cache = RadixCache(pool)
+    for p in paths:
+        blocks = pool.alloc(len(p) // block_size)
+        cache.insert(p, blocks)
+        pool.unref(blocks)
+    return cache.summary()
+
+
+def serving(queue_depth=0, n_slots=2, summary=None) -> dict:
+    d = {"queue_depth": queue_depth, "n_slots": n_slots}
+    if summary is not None:
+        d["cache_summary"] = summary
+    return d
+
+
+class TestScoring:
+    def test_queue_pressure_normalizes_and_survives_garbage(self):
+        assert scoring.queue_pressure({"queue_depth": 4, "n_slots": 2}) == 2.0
+        assert scoring.queue_pressure({"queue_depth": 3}) == 3.0
+        assert scoring.queue_pressure({}) == 0.0
+        assert scoring.queue_pressure(None) == 0.0
+        assert scoring.queue_pressure({"queue_depth": "wat"}) == 0.0
+
+    def test_match_depth_takes_deepest_even_with_gaps(self):
+        fps = prefix_fingerprints(list(range(12)), 4)
+        assert scoring.match_depth(fps, set(fps)) == 3
+        # summary truncation can drop an ancestor: depth must still be
+        # the deepest membership, not the first contiguous run
+        assert scoring.match_depth(fps, {fps[2]}) == 3
+        assert scoring.match_depth(fps, set()) == 0
+
+    def test_replica_score_stale_penalty(self):
+        fresh = scoring.replica_score(4, 0.5, stale=False)
+        stale = scoring.replica_score(4, 0.5, stale=True)
+        assert stale == fresh - scoring.STALE_PENALTY_BLOCKS
+
+
+class TestFleetRouter:
+    def mk(self, clock=None):
+        clk = clock or SimulatedClock(start=100.0)
+        r = FleetRouter(clock=clk.now)
+        return r, clk
+
+    def test_affinity_beats_idle_no_match(self):
+        r, _ = self.mk()
+        toks = list(range(12))
+        r.add_replica("warm", "http://w")
+        r.add_replica("cold", "http://c")
+        r.update_replica("warm", serving(summary=summary_of(toks)))
+        r.update_replica("cold", serving(summary=summary_of([9, 9, 9, 9])))
+        d = r.route(toks + [77])
+        assert (d.replica, d.match_blocks, d.fallback) == ("warm", 3, False)
+        assert d.match_tokens == 12
+
+    def test_queue_pressure_overrides_shallow_match(self):
+        r, _ = self.mk()
+        toks = [5, 6, 7, 8]
+        r.add_replica("busy", "http://b")
+        r.add_replica("idle", "http://i")
+        # 1 matched block vs alpha*2 queues-per-slot of pressure
+        r.update_replica("busy", serving(queue_depth=4, n_slots=2,
+                                         summary=summary_of(toks)))
+        r.update_replica("idle", serving(summary=summary_of([1, 1, 1, 1])))
+        assert r.route(toks).replica == "idle"
+
+    def test_fallback_is_least_loaded(self):
+        r, _ = self.mk()
+        r.add_replica("a", "http://a")
+        r.add_replica("b", "http://b")
+        r.update_replica("a", serving(queue_depth=3))
+        r.update_replica("b", serving(queue_depth=1))
+        d = r.route([200, 201, 202, 203])
+        assert (d.replica, d.fallback) == ("b", True)
+        assert r.metrics["routed"].value("b", "fallback") == 1
+        assert r.affinity_hit_rate == 0.0
+
+    def test_stale_penalized_dead_dropped(self):
+        r, clk = self.mk()
+        toks = list(range(8))
+        r.add_replica("old", "http://o")
+        r.add_replica("new", "http://n")
+        r.update_replica("old", serving(summary=summary_of(toks)))
+        clk.advance(scoring.STALE_AFTER_S + 1)
+        r.update_replica("new", serving())
+        # old advertises 2 blocks but is stale: 2 - 8 < 0 -> new wins
+        d = r.route(toks)
+        assert d.replica == "new"
+        assert r.metrics["replicas"].value("stale") == 1
+        clk.advance(scoring.DEAD_AFTER_S)
+        # old is now past the TTL entirely; new is merely stale
+        d = r.route(toks)
+        assert d.replica == "new" and d.candidates == 1
+        assert r.metrics["skipped"].value("old", "dead") == 1
+        clk.advance(scoring.DEAD_AFTER_S)
+        with pytest.raises(NoReplicaError):
+            r.route(toks)
+
+    def test_breaker_open_excluded_until_cooldown(self):
+        r, clk = self.mk()
+        r.add_replica("flaky", "http://f")
+        r.add_replica("ok", "http://k")
+        r.update_replica("flaky", serving())
+        r.update_replica("ok", serving(queue_depth=4))
+        flaky = r.replicas()[0]
+        assert flaky.name == "flaky"
+        for _ in range(3):
+            flaky.breaker.record_failure()
+        # despite better (lower-pressure) score, flaky is skipped
+        assert r.route([300, 301, 302, 303]).replica == "ok"
+        assert r.metrics["skipped"].value("flaky", "breaker") == 1
+        clk.advance(10.0)  # past reset_timeout: half-open is eligible
+        assert r.route([300, 301, 302, 303]).replica == "flaky"
+
+    def test_optimistic_insert_creates_affinity_before_refresh(self):
+        r, _ = self.mk()
+        toks = list(range(8))
+        r.add_replica("a", "http://a")
+        r.add_replica("b", "http://b")
+        # block_size comes from the first authoritative summary
+        r.update_replica("a", serving(summary=summary_of([9, 9, 9, 9])))
+        r.update_replica("b", serving(summary=summary_of([8, 8, 8, 8])))
+        first = r.route(toks)
+        assert first.fallback
+        r.note_routed(first, toks)
+        again = r.route(toks)
+        assert (again.replica, again.fallback) == (first.replica, False)
+        # authoritative refresh without those paths clears the guess
+        r.update_replica(first.replica,
+                         serving(summary=summary_of([9, 9, 9, 9])))
+        assert r.route(toks).fallback
+
+    def test_route_fault_point(self):
+        r, _ = self.mk()
+        r.add_replica("a", "http://a")
+        r.update_replica("a", serving())
+        REGISTRY.arm(FaultSpec("router.route", "error", kind="timeout"))
+        with pytest.raises(TimeoutError):
+            r.route([1, 2, 3, 4])
+
+
+class TestStoreRoundTrip:
+    """servingStats over the real heartbeat: engine-shaped stats dict ->
+    NodeAgent -> store write -> NodeState list -> router scoring."""
+
+    def heartbeat_node(self, store, name, stats, clock, tmp_path):
+        agent = NodeAgent(
+            store, name, gpu_capacity=8, gpu_memory_bytes=64 << 30,
+            model_root=str(tmp_path / name), clock=clock,
+            serving_stats=lambda: stats,
+        )
+        agent.heartbeat()
+
+    def test_roundtrip_scores_from_store_view(self, tmp_path):
+        store = Store()
+        clock = SimulatedClock(start=1000.0)
+        toks = list(range(12))
+        self.heartbeat_node(
+            store, "node-warm",
+            serving(summary=summary_of(toks)), clock, tmp_path,
+        )
+        self.heartbeat_node(
+            store, "node-cold",
+            serving(summary=summary_of([7, 7, 7, 7])), clock, tmp_path,
+        )
+        router = FleetRouter(clock=clock.now)
+        router.add_replica("node-warm", "http://w:8000")
+        router.add_replica("node-cold", "http://c:8000")
+        states = [NodeState.from_dict(d) for d in store.list(NodeState.KIND)]
+        router.update_from_nodestates(states, now=clock.now())
+        d = router.route(toks)
+        assert (d.replica, d.match_blocks) == ("node-warm", 3)
+
+    def test_stale_heartbeat_penalized_dead_dropped(self, tmp_path):
+        store = Store()
+        clock = SimulatedClock(start=1000.0)
+        toks = list(range(12))
+        self.heartbeat_node(
+            store, "node-a", serving(summary=summary_of(toks)),
+            clock, tmp_path,
+        )
+        clock.advance(scoring.STALE_AFTER_S + 5)
+        self.heartbeat_node(store, "node-b", serving(), clock, tmp_path)
+        router = FleetRouter(clock=clock.now)
+        router.add_replica("node-a", "http://a:8000")
+        router.add_replica("node-b", "http://b:8000")
+        states = [NodeState.from_dict(d) for d in store.list(NodeState.KIND)]
+        router.update_from_nodestates(states, now=clock.now())
+        # a's 3-block match is discounted below b's fresh empty score
+        assert router.route(toks).replica == "node-b"
+        # age a past the dead TTL: it must leave the candidate set
+        clock.advance(scoring.DEAD_AFTER_S)
+        router.update_from_nodestates(states, now=clock.now())
+        d = router.route(toks)
+        assert d.replica == "node-b" and d.candidates == 1
+
+    def test_heartbeat_clamps_oversized_summary(self, tmp_path):
+        store = Store()
+        clock = SimulatedClock(start=1000.0)
+        big = serving(summary={
+            "version": 1, "block_size": 4, "total_nodes": 10_000,
+            "truncated": False,
+            "fingerprints": list(range(SUMMARY_FINGERPRINT_BUDGET + 100)),
+        })
+        self.heartbeat_node(store, "node-big", big, clock, tmp_path)
+        state = NodeState.from_dict(store.get(NodeState.KIND, "node-big"))
+        got = state.serving_stats["cache_summary"]
+        assert len(got["fingerprints"]) == SUMMARY_FINGERPRINT_BUDGET
+        # deterministic: the producer orders hottest-first; the clamp
+        # keeps the prefix and flags the cut
+        assert got["fingerprints"] == list(range(SUMMARY_FINGERPRINT_BUDGET))
+        assert got["truncated"] is True
+        # the callback's own dict was not mutated
+        assert len(big["cache_summary"]["fingerprints"]) == \
+            SUMMARY_FINGERPRINT_BUDGET + 100
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY, jax.random.PRNGKey(0))
+
+
+def mk_replica(params, name):
+    cont = ContinuousEngine(
+        params, TINY, n_slots=2, cache_len=128, block_size=BS,
+    ).start()
+    srv = InferenceServer(
+        Engine(params, TINY), model_id=name, port=0, continuous=cont,
+    ).start()
+    return srv, cont
+
+
+def mk_fleet(params, n=2):
+    replicas = [mk_replica(params, f"r{i}") for i in range(n)]
+    router = FleetRouter()
+    for i, (srv, _) in enumerate(replicas):
+        router.add_replica(f"r{i}", f"http://127.0.0.1:{srv.port}")
+    rs = RouterServer(router, port=0).start(poll=False)
+    rs.poll_once()
+    return replicas, router, rs
+
+
+def stop_fleet(replicas, rs):
+    rs.stop()
+    for srv, cont in replicas:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001 — may already be chaos-killed
+            pass
+        cont.stop()
+
+
+def post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.mark.slow
+class TestRouterHTTP:
+    @pytest.fixture(scope="class")
+    def fleet(self, params):
+        replicas, router, rs = mk_fleet(params)
+        yield replicas, router, rs
+        stop_fleet(replicas, rs)
+
+    def test_affinity_sticks_and_annotates(self, fleet):
+        _, _, rs = fleet
+        fam = [list(range(1, 33)), list(range(100, 132))]
+        for f in fam:
+            _, first = post(rs.port, {"prompt": f + [50], "max_tokens": 2})
+            _, second = post(rs.port, {"prompt": f + [51], "max_tokens": 2})
+            assert second["kubeinfer"]["replica"] == \
+                first["kubeinfer"]["replica"]
+            assert second["kubeinfer"]["match_blocks"] >= 2
+            assert second["kubeinfer"]["fallback"] is False
+        # the proxy relays the replica's own response intact
+        assert "choices" in second and "ttft_ms" in second["kubeinfer"]
+
+    def test_string_prompt_falls_back_but_serves(self, fleet):
+        replicas, _, rs = fleet
+        # no tokenizer on the replicas: the REPLICA rejects strings with
+        # 400, and the router must relay that verdict, not mask it
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rs.port}/v1/completions",
+            data=json.dumps({"prompt": "hello", "max_tokens": 2}).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+
+    def test_debug_and_metrics_endpoints(self, fleet):
+        _, router, rs = fleet
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rs.port}/replicas", timeout=10
+        ) as r:
+            snap = json.loads(r.read())
+        assert {v["name"] for v in snap} == {"r0", "r1"}
+        assert all(v["breaker"] == "closed" for v in snap)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rs.port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert "kubeinfer_router_requests_total" in body
+        assert "kubeinfer_router_affinity_hit_ratio" in body
+
+    def test_poll_refreshes_authoritative_view(self, fleet):
+        replicas, router, rs = fleet
+        assert rs.poll_once() == 2
+        views = {v.name: v for v in router.replicas()}
+        assert views["r0"].block_size == BS
+        assert views["r0"].version >= 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestRouterChaos:
+    def test_replica_kill_midrun_is_token_lossless(self, params):
+        """The acceptance scenario: kill one replica's endpoint while
+        traffic flows. The breaker opens, decisions re-score onto the
+        survivor, and every response — including ones racing the kill —
+        carries exactly the tokens the reference engine produces for
+        that prompt (deterministic greedy: any replica serves identical
+        tokens, so a reroute is invisible in the payload)."""
+        replicas, router, rs = mk_fleet(params)
+        ref = ContinuousEngine(
+            params, TINY, n_slots=2, cache_len=128, block_size=BS,
+        ).start()
+        try:
+            fams = [list(range(1, 33)), list(range(100, 132))]
+            prompts = [f + [200 + i] for i, f in enumerate(fams * 6)]
+            expect = {
+                tuple(p): ref.generate(p, max_new_tokens=4, eos_id=-1)
+                for p in prompts
+            }
+            results: queue.Queue = queue.Queue()
+            work: queue.Queue = queue.Queue()
+
+            def client():
+                while True:
+                    try:
+                        p = work.get_nowait()
+                    except queue.Empty:
+                        return
+                    status, body = post(rs.port, {
+                        "prompt": p, "max_tokens": 4,
+                    })
+                    results.put((p, status, body))
+
+            for p in prompts[:4]:  # warm both replicas' caches + shapes
+                work.put(p)
+            client()
+            victim = router.route(prompts[0]).replica
+            for p in prompts[4:]:
+                work.put(p)
+            threads = [threading.Thread(target=client) for _ in range(3)]
+            for t in threads:
+                t.start()
+            # kill the victim's endpoint while the workers are mid-run
+            replicas[int(victim[1])][0].stop()
+            for t in threads:
+                t.join(timeout=300)
+            assert not any(t.is_alive() for t in threads)
+            seen = 0
+            while not results.empty():
+                p, status, body = results.get()
+                assert status == 200
+                assert body["choices"][0]["tokens"] == expect[tuple(p)], (
+                    f"tokens diverged for prompt {p[:4]}..."
+                )
+                seen += 1
+            assert seen == len(prompts)
+            # degradation is visible, correctness was not: the victim's
+            # breaker opened and decisions moved to the survivor
+            views = {v.name: v for v in router.replicas()}
+            assert views[victim].breaker.state == "open"
+            skipped = router.metrics["skipped"]
+            assert (
+                skipped.value(victim, "breaker")
+                + skipped.value(victim, "failed")
+            ) > 0
+            survivor = "r1" if victim == "r0" else "r0"
+            assert router.metrics["requests"].value(survivor, "ok") > 0
+        finally:
+            ref.stop()
+            stop_fleet(replicas, rs)
+
+    def test_injected_proxy_fault_rescores(self, params):
+        """router.proxy fault point: injected connection resets on one
+        replica behave exactly like the real kill — excluded for the
+        request, served by the other replica, same tokens."""
+        replicas, router, rs = mk_fleet(params)
+        try:
+            p = list(range(40, 72)) + [1]
+            _, clean = post(rs.port, {"prompt": p, "max_tokens": 3})
+            home = clean["kubeinfer"]["replica"]
+            REGISTRY.arm(FaultSpec(
+                "router.proxy", "error", kind="reset", match=home,
+            ))
+            _, rerouted = post(rs.port, {"prompt": p, "max_tokens": 3})
+            assert rerouted["kubeinfer"]["replica"] != home
+            assert rerouted["choices"][0]["tokens"] == \
+                clean["choices"][0]["tokens"]
+            assert router.metrics["requests"].value(home, "unreachable") > 0
+        finally:
+            stop_fleet(replicas, rs)
