@@ -6,7 +6,8 @@ PY ?= python
 # whatever JAX backend is live (real TPU chip if present).
 
 .PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
-        bench-full lint trace-demo run-manager run-agent docker-build clean
+        bench-full lint sanitize trace-demo run-manager run-agent \
+        docker-build clean
 
 all: native lint test-fast
 
@@ -28,7 +29,17 @@ test-e2e: native
 # Resilience tier: RetryPolicy/breaker units + deterministic
 # fault-injection scenarios (tests/test_chaos.py). Part of `test` too;
 # this target is the focused loop when iterating on failure handling.
+# Chaos-marked tests arm KUBEINFER_RACECHECK=2 via conftest, so the
+# lockset race detector and lock-order graph run as teardown oracles.
 test-chaos:
+	$(PY) -m pytest tests/ -q -x -m chaos
+
+# Concurrency sanitizer (docs/ANALYSIS.md): 8 seeded deterministic
+# schedules per fuzz scenario with the lockset detector armed, then the
+# chaos tier under the same oracles. Bounded: the fuzzer serializes
+# tiny in-process scenarios (~seconds), no jit compiles involved.
+sanitize:
+	$(PY) -m kubeinfer_tpu.analysis.schedfuzz --schedules 8
 	$(PY) -m pytest tests/ -q -x -m chaos
 
 bench: native

@@ -27,6 +27,7 @@ from typing import Callable
 
 from kubeinfer_tpu import metrics
 from kubeinfer_tpu.agent.coordinator import Coordinator, hub_download
+from kubeinfer_tpu.analysis.racecheck import guard
 from kubeinfer_tpu.agent.follower import Follower
 from kubeinfer_tpu.agent.model_server import ensure_model_dir
 from kubeinfer_tpu.agent.runtime import RuntimeConfig
@@ -451,6 +452,7 @@ class NodeAgent:
         self._stale_since: float | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        guard(self)
 
     # -- node-state reporting ----------------------------------------------
 

@@ -1,0 +1,306 @@
+"""Blocking-call-under-lock AST pass (rule ``blocking-under-lock``).
+
+A lock held across a blocking operation turns one slow caller into a
+convoy: every thread that touches the lock inherits the blocker's
+latency. This is a *known live* hazard here — the engine lock
+deliberately spans admit jit compiles (potentially tens of seconds on a
+cold shape), which is why PR 6's ``stats_summary`` had to go lockless.
+This pass makes each such span a deliberate, documented decision
+instead of an accident: every finding is either restructured or carries
+a reasoned ``# lint: allow[blocking-under-lock]`` stating the latency
+ceiling being accepted.
+
+Blocking operations flagged (the ISSUE 9 set):
+
+- ``time.sleep`` and clock-protocol ``.sleep(...)`` calls
+- ``subprocess.*`` (run/Popen/check_output/...)
+- HTTP: ``urlopen``, ``requests.*`` / ``httpx.*`` calls
+- device sync: ``.block_until_ready()``, ``jax.device_get``,
+  ``jax.block_until_ready``
+- jit dispatch: calling a name the cross-file jit registry knows is
+  jit-compiled — the first call per shape IS a compile
+
+Interprocedural, per class, reusing lockcheck's shape: a method body is
+walked with a ``with self.<lock>`` depth counter (locks discovered the
+same two ways as lockcheck: factory assignment + lock-ish ``with``
+targets). Direct findings land on the blocking line. Transitive
+findings land on the CALL line under the lock when the callee's
+intra-class closure reaches a blocking call — the suppression then
+lives where the lock scope is chosen, which is where the fix would go.
+Closures are analyzed at depth 0 like lockcheck (nothing says they run
+before the lock drops). Module-level functions get the same treatment
+against module ``_lock`` globals.
+
+Condition ``.wait()`` is deliberately NOT flagged: it releases the lock
+while blocked, which is the correct pattern, not the hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from kubeinfer_tpu.analysis.core import Finding
+from kubeinfer_tpu.analysis.jitlint import _dotted
+from kubeinfer_tpu.analysis.lockcheck import (
+    _INIT_NAMES,
+    _is_lock_factory,
+    _looks_like_lock,
+)
+
+__all__ = ["run"]
+
+_SUBPROCESS = ("subprocess.",)
+_HTTP_PREFIXES = ("requests.", "httpx.")
+_DEVICE_SYNC = {"jax.device_get", "jax.block_until_ready"}
+
+
+def _classify(call: ast.Call, jit_names) -> str | None:
+    """Blocking-kind label for a call, or None. The label goes into the
+    finding message verbatim, so it names the operation precisely."""
+    chain = _dotted(call.func) or ""
+    if not chain:
+        return None
+    tail = chain.rsplit(".", 1)[-1]
+    if chain == "time.sleep" or (tail == "sleep" and "." in chain):
+        return f"{chain}()"
+    if chain.startswith(_SUBPROCESS):
+        return f"{chain}()"
+    if tail == "urlopen" or chain.startswith(_HTTP_PREFIXES):
+        return f"HTTP {chain}()"
+    if chain in _DEVICE_SYNC or tail == "block_until_ready":
+        return f"device sync {chain}()"
+    # jit dispatch: bare-name calls to registered jit entries (attribute
+    # tails too — `self._fwd` style handles are registered by assignment
+    # name in jitlint.collect_jit_names)
+    if chain in jit_names or tail in jit_names:
+        return f"jit dispatch {chain}() (compiles on new shapes)"
+    return None
+
+
+@dataclass
+class _Site:
+    line: int
+    detail: str
+    locked: bool
+
+
+@dataclass
+class _Method:
+    name: str
+    sites: list = field(default_factory=list)       # _Site
+    calls: list = field(default_factory=list)       # (callee, locked, line)
+
+
+class _Walker:
+    """One function body: blocking sites + intra-scope calls, each
+    tagged with whether a tracked lock is held lexically at that point."""
+
+    def __init__(self, info: _Method, lock_names: set, jit_names,
+                 self_name: str | None) -> None:
+        self.info = info
+        self.lock_names = lock_names
+        self.jit_names = jit_names
+        self.self_name = self_name  # None => module-level scope
+        self.depth = 0
+        self.with_locks: set[str] = set()
+
+    def _lockish(self, expr) -> str | None:
+        """Lock name when ``expr`` is a tracked lock reference
+        (``self.X`` in class scope, bare ``X`` at module level)."""
+        if self.self_name is not None:
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == self.self_name):
+                if expr.attr in self.lock_names or _looks_like_lock(expr.attr):
+                    return expr.attr
+        elif isinstance(expr, ast.Name) and expr.id in self.lock_names:
+            return expr.id
+        return None
+
+    def _callee(self, call: ast.Call) -> str | None:
+        if self.self_name is not None:
+            f = call.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == self.self_name):
+                return f.attr
+            return None
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        return None
+
+    def _scan_expr(self, node) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            detail = _classify(sub, self.jit_names)
+            if detail is not None:
+                self.info.sites.append(
+                    _Site(sub.lineno, detail, self.depth > 0))
+            callee = self._callee(sub)
+            if callee is not None:
+                self.info.calls.append((callee, self.depth > 0, sub.lineno))
+
+    def walk(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closures run at unknown times relative to the lock scope —
+            # same depth-0 treatment as lockcheck
+            saved = self.depth
+            self.depth = 0
+            self.walk(st.body)
+            self.depth = saved
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            holds = 0
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+                name = self._lockish(item.context_expr)
+                if name is not None:
+                    self.with_locks.add(name)
+                    holds += 1
+            self.depth += holds
+            self.walk(st.body)
+            self.depth -= holds
+            return
+        for _f, value in ast.iter_fields(st):
+            if isinstance(value, ast.expr):
+                self._scan_expr(value)
+            elif isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self.walk(value)
+                elif value and isinstance(value[0], ast.expr):
+                    for v in value:
+                        self._scan_expr(v)
+                elif value and isinstance(value[0], ast.excepthandler):
+                    for h in value:
+                        self.walk(h.body)
+                elif value and isinstance(value[0], ast.match_case):
+                    for c in value:
+                        self.walk(c.body)
+
+
+def _transitive_blocks(methods: dict) -> dict:
+    """method -> set of blocking details reachable from its body at
+    depth 0 (details already under the method's OWN lock are excluded —
+    they are reported directly at their line). Fixpoint over the
+    intra-scope call graph."""
+    blocks: dict[str, set] = {
+        n: {s.detail for s in m.sites if not s.locked}
+        for n, m in methods.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for n, m in methods.items():
+            for callee, _locked, _line in m.calls:
+                sub = blocks.get(callee)
+                if sub and not sub <= blocks[n]:
+                    blocks[n] |= sub
+                    changed = True
+    return blocks
+
+
+def _emit(scope: str, methods: dict, path: str, findings: list) -> None:
+    blocks = _transitive_blocks(methods)
+    seen: set[tuple[int, str]] = set()
+    for name, m in methods.items():
+        if name in _INIT_NAMES:
+            # nothing shares the object mid-__init__, so a lock taken
+            # there cannot convoy another thread (direct or transitive)
+            continue
+        for s in m.sites:
+            if s.locked and (s.line, s.detail) not in seen:
+                seen.add((s.line, s.detail))
+                findings.append(Finding(
+                    path, s.line, "blocking-under-lock",
+                    f"{scope}{name}: {s.detail} while holding a lock"))
+        for callee, locked, line in m.calls:
+            if not locked or callee in _INIT_NAMES:
+                continue
+            reach = blocks.get(callee)
+            if reach and (line, callee) not in seen:
+                seen.add((line, callee))
+                detail = sorted(reach)[0]
+                findings.append(Finding(
+                    path, line, "blocking-under-lock",
+                    f"{scope}{name}: call to {callee}() under lock "
+                    f"reaches {detail}"))
+
+
+def _analyze_class(cls: ast.ClassDef, path: str, jit_names,
+                   findings: list) -> None:
+    lock_attrs: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    lock_attrs.add(tgt.attr)
+    # two sweeps, like lockcheck: `with self.X` uses grow the lock set
+    defs = [st for st in cls.body
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def sweep() -> dict:
+        methods: dict[str, _Method] = {}
+        walkers = []
+        for st in defs:
+            a = st.args
+            self_name = (a.posonlyargs + a.args)[0].arg \
+                if (a.posonlyargs + a.args) else "self"
+            info = _Method(st.name)
+            methods[st.name] = info
+            w = _Walker(info, lock_attrs, jit_names, self_name)
+            w.walk(st.body)
+            walkers.append(w)
+        for w in walkers:
+            lock_attrs.update(w.with_locks)
+        return methods
+
+    sweep()
+    methods = sweep()
+    if not any(s.locked for m in methods.values() for s in m.sites) \
+            and not any(locked for m in methods.values()
+                        for _c, locked, _l in m.calls):
+        return
+    _emit(f"{cls.name}.", methods, path, findings)
+
+
+def _analyze_module(tree: ast.Module, path: str, jit_names,
+                    findings: list) -> None:
+    mod_locks = {
+        tgt.id
+        for st in tree.body if isinstance(st, ast.Assign)
+        if _is_lock_factory(st.value)
+        for tgt in st.targets if isinstance(tgt, ast.Name)
+    }
+    if not mod_locks:
+        return
+    methods: dict[str, _Method] = {}
+    for st in tree.body:
+        if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _Method(st.name)
+        methods[st.name] = info
+        _Walker(info, mod_locks, jit_names, None).walk(st.body)
+    _emit("", methods, path, findings)
+
+
+def run(tree: ast.AST, path: str, jit_registry: dict | None = None
+        ) -> list[Finding]:
+    findings: list[Finding] = []
+    jit_names = frozenset(jit_registry or ())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _analyze_class(node, path, jit_names, findings)
+    if isinstance(tree, ast.Module):
+        _analyze_module(tree, path, jit_names, findings)
+    return findings

@@ -63,6 +63,20 @@ RULES = {
         "kubeinfer_ prefix / unit-suffix convention (Counter: _total; "
         "Histogram: _seconds/_bytes; Gauge: unit or quantity suffix)"
     ),
+    "metric-label": (
+        "metric label that is not [a-z_]+ or is a known high-cardinality "
+        "key (request/trace/prompt ids explode the series count)"
+    ),
+    "blocking-under-lock": (
+        "blocking call (sleep/subprocess/HTTP/jit dispatch/device sync) "
+        "reachable while a lock is held — fix, or document the accepted "
+        "latency ceiling in the allow reason"
+    ),
+    "unused-suppression": (
+        "a `# lint: allow[rule]` whose rule no longer fires on its "
+        "target line (stale suppressions rot; this finding is itself "
+        "unsuppressable)"
+    ),
     "lint-bare-allow": (
         "a `# lint: allow[rule]` without a reason string (reasons are "
         "mandatory; this finding is itself unsuppressable)"
@@ -87,16 +101,52 @@ class Finding:
         return f"{self.path}:{self.line} {self.rule} {self.message}"
 
 
+# meta rules about the suppression mechanism itself: letting these be
+# allowed away would let suppressions rot invisibly
+_UNSUPPRESSABLE = ("lint-bare-allow", "lint-unknown-rule",
+                   "unused-suppression")
+
+
+@dataclass
+class _Allow:
+    line: int  # the comment's own line — where unused findings land
+    rules: set
+    reason: str
+    used: set = field(default_factory=set)
+
+
 @dataclass
 class _Suppressions:
-    # line number (1-based) -> set of rule ids allowed on that line
+    # target line (1-based) -> allow entries covering it
     by_line: dict = field(default_factory=dict)
+    entries: list = field(default_factory=list)
     meta_findings: list = field(default_factory=list)
 
     def allows(self, finding: Finding) -> bool:
-        if finding.rule in ("lint-bare-allow", "lint-unknown-rule"):
+        if finding.rule in _UNSUPPRESSABLE:
             return False
-        return finding.rule in self.by_line.get(finding.line, ())
+        hit = False
+        for a in self.by_line.get(finding.line, ()):
+            if finding.rule in a.rules:
+                a.used.add(finding.rule)
+                hit = True
+        return hit
+
+    def unused_findings(self, path: str) -> list:
+        """Stale allows, computed AFTER the real passes consumed their
+        matches. Bare allows and unknown rules are excluded — they
+        already carry their own meta finding."""
+        out = []
+        for a in self.entries:
+            if not a.reason:
+                continue
+            for r in sorted(a.rules):
+                if r in RULES and r not in a.used:
+                    out.append(Finding(
+                        path, a.line, "unused-suppression",
+                        f"allow[{r}] no longer matches any finding on "
+                        f"its target line"))
+        return out
 
 
 def _iter_comments(source: str):
@@ -140,8 +190,10 @@ def _collect_suppressions(source: str, path: str) -> _Suppressions:
         targets = [i]
         if line_text[:col].strip() == "":
             targets.append(i + 1)
+        entry = _Allow(i, rules, reason)
+        sup.entries.append(entry)
         for t in targets:
-            sup.by_line.setdefault(t, set()).update(rules)
+            sup.by_line.setdefault(t, []).append(entry)
     return sup
 
 
@@ -194,7 +246,7 @@ def analyze_source(
     # local imports: core is imported by racecheck users at runtime and
     # must not pay for the AST passes unless analysis actually runs
     from kubeinfer_tpu.analysis import (
-        jitlint, lockcheck, logdiscipline, metricnames,
+        blockcheck, jitlint, lockcheck, logdiscipline, metricnames,
     )
 
     if boundary is None:
@@ -216,9 +268,14 @@ def analyze_source(
     findings.extend(lockcheck.run(tree, path))
     findings.extend(logdiscipline.run(tree, path))
     findings.extend(metricnames.run(tree, path))
+    if not _is_test_file(path):
+        # tests sleep/poll under fixture locks by design; the convoy
+        # hazard only exists on library code paths
+        findings.extend(blockcheck.run(tree, path, call_registry))
     sup = _collect_suppressions(source, path)
     findings = [f for f in findings if not sup.allows(f)]
     findings.extend(sup.meta_findings)
+    findings.extend(sup.unused_findings(path))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -1,0 +1,245 @@
+"""Dynamic lockset race detector (armed by ``KUBEINFER_RACECHECK=2``).
+
+Eraser-style lockset analysis (Savage et al., "Eraser: A Dynamic Data
+Race Detector for Multithreaded Programs" — the reliability-thread
+citation in PAPERS.md): for every shared field, maintain the candidate
+set C(v) of locks held at EVERY access so far. If two threads write the
+field and C(v) is empty, no single lock protected it — a data race even
+when the observed schedule happened to be benign. This is exactly the
+class the static passes cannot see: cross-class races (lockcheck is
+per-class) and lock-free torn publishes (lockcheck only compares
+locked-vs-unlocked writes *within* one class's methods).
+
+State machine per ``(object, attr)``, adapted to write-interception:
+
+- first write           → EXCLUSIVE(owner thread); C(v) := locks held
+- write by owner        → stays EXCLUSIVE (single-writer init is free)
+- ``note_read`` by another thread → SHARED (refine C(v), never report)
+- write by any second thread      → SHARED-MODIFIED
+- in SHARED-MODIFIED, ≥2 writer threads and C(v) = ∅ → race, reported
+  once per (class, attr) with both write sites and the thread names
+
+Instrumentation is the ``guard(obj)`` hook: it swaps the instance onto
+a dynamically created subclass whose ``__setattr__`` feeds this
+registry, so only *registered* objects pay anything and only at
+level 2 (``racecheck.guard`` is the no-op-below-level-2 front door
+components call at the end of ``__init__`` — after construction, so
+pre-sharing init writes never enter the state machine). Locksets come
+from racecheck's per-thread held stack and intersect by lock *id*:
+two Store instances' same-named ``_lock``s do not protect each other.
+
+Deliberate limits (a detector, not a prover): container mutation
+(``self._items.append``) is invisible — only rebinds are intercepted
+(the static mutator pass covers the container idioms); reads are
+tracked only via explicit ``note_read``; threads are distinguished by
+a monotonically assigned token held in ``threading.local`` storage, so
+OS thread-id reuse can never merge two threads' access histories.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import weakref
+
+from kubeinfer_tpu.analysis import racecheck
+
+__all__ = ["guard", "note_read", "REGISTRY", "LocksetRegistry"]
+
+EXCLUSIVE, SHARED, SHARED_MODIFIED = "exclusive", "shared", "shared-modified"
+
+# attrs every guarded object may touch freely: the lock fields
+# themselves (rebound only in __init__, but belt-and-braces) and
+# anything dunder/private-to-the-detector
+_ALWAYS_IGNORED_SUFFIXES = ("_lock", "_mu", "_mutex", "_cond", "_cv")
+
+_tls = threading.local()
+_token_mu = threading.Lock()
+_token_next = [1]
+
+
+def _thread_token() -> tuple[int, str]:
+    """(monotonic token, thread name) for the calling thread. The token
+    is assigned once per thread OBJECT and cached in threading.local,
+    so a reused OS thread id can never alias two threads' histories."""
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        with _token_mu:
+            n = _token_next[0]
+            _token_next[0] += 1
+        tok = _tls.token = (n, threading.current_thread().name)
+    return tok
+
+
+class _FieldState:
+    __slots__ = ("state", "owner", "lockset", "locknames", "writers",
+                 "threads", "first_site", "reported", "cls")
+
+    def __init__(self, cls: str, owner, lockset, locknames, site: str,
+                 is_write: bool) -> None:
+        self.cls = cls
+        self.state = EXCLUSIVE
+        self.owner = owner
+        self.lockset = lockset          # set of lock ids
+        self.locknames = locknames      # id -> name, for reports
+        self.writers = {owner} if is_write else set()
+        self.threads = {owner}
+        self.first_site = site
+        self.reported = False
+
+
+class LocksetRegistry:
+    """Process-global field states + confirmed races.
+
+    Uses a plain ``threading.Lock``: the detector must never feed
+    itself (a tracked lock here would recurse through ``held()``).
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (id(obj), attr) -> _FieldState
+        self._fields: dict[tuple[int, str], _FieldState] = {}
+        # id(obj) -> attrs with a documented benign-race story
+        self._ignores: dict[int, set[str]] = {}
+        # (class name, attr) -> race report dict, first occurrence wins
+        self._races: dict[tuple[str, str], dict] = {}
+
+    # -- registration -----------------------------------------------------
+
+    def register(self, obj, ignore=()) -> None:
+        oid = id(obj)
+        with self._mu:
+            self._ignores.setdefault(oid, set()).update(ignore)
+        # drop this object's states when it dies, BEFORE CPython can
+        # hand its id to a new allocation
+        weakref.finalize(obj, self._forget, oid)
+
+    def _forget(self, oid: int) -> None:
+        with self._mu:
+            self._ignores.pop(oid, None)
+            for key in [k for k in self._fields if k[0] == oid]:
+                del self._fields[key]
+
+    # -- the state machine ------------------------------------------------
+
+    def on_write(self, obj, attr: str) -> None:
+        self._on_access(obj, attr, is_write=True, depth=3)
+
+    def note_read(self, obj, attr: str) -> None:
+        """Optional read-side feed for single-writer/multi-reader
+        fields: moves EXCLUSIVE → SHARED and refines the lockset
+        without ever reporting on its own."""
+        self._on_access(obj, attr, is_write=False, depth=3)
+
+    def _on_access(self, obj, attr: str, is_write: bool,
+                   depth: int) -> None:
+        if attr.startswith("__") or attr.endswith(_ALWAYS_IGNORED_SUFFIXES):
+            return
+        held = racecheck.REGISTRY.held()
+        held_ids = {i for i, _n in held}
+        tok = _thread_token()
+        f = sys._getframe(depth)
+        site = f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+        key = (id(obj), attr)
+        with self._mu:
+            ig = self._ignores.get(id(obj))
+            if ig and attr in ig:
+                return
+            st = self._fields.get(key)
+            if st is None:
+                self._fields[key] = _FieldState(
+                    type(obj).__name__, tok, held_ids,
+                    dict(held), site, is_write,
+                )
+                return
+            st.lockset &= held_ids
+            st.locknames = {i: n for i, n in st.locknames.items()
+                            if i in st.lockset}
+            st.threads.add(tok)
+            if is_write:
+                st.writers.add(tok)
+            if tok != st.owner:
+                if st.state == EXCLUSIVE:
+                    st.state = SHARED_MODIFIED if is_write else SHARED
+            if st.state == SHARED and is_write:
+                st.state = SHARED_MODIFIED
+            if (st.state == SHARED_MODIFIED and len(st.writers) >= 2
+                    and not st.lockset and not st.reported):
+                st.reported = True
+                rkey = (st.cls, attr)
+                if rkey not in self._races:
+                    self._races[rkey] = {
+                        "class": st.cls,
+                        "attr": attr,
+                        "threads": sorted(n for _t, n in st.writers),
+                        "first_site": st.first_site,
+                        "site": site,
+                    }
+
+    # -- reporting --------------------------------------------------------
+
+    def races(self) -> list[dict]:
+        with self._mu:
+            return [self._races[k] for k in sorted(self._races)]
+
+    def render(self) -> str:
+        return "\n".join(
+            f"lockset race: {r['class']}.{r['attr']} written by "
+            f"{', '.join(r['threads'])} with empty lockset "
+            f"(first write {r['first_site']}, racing write {r['site']})"
+            for r in self.races()
+        )
+
+    def reset(self) -> None:
+        """Clear field states and races between scenarios. Ignore sets
+        stay — they are tied to live objects, not to scenarios."""
+        with self._mu:
+            self._fields.clear()
+            self._races.clear()
+
+
+REGISTRY = LocksetRegistry()
+
+# original class -> guarded subclass (one per class, reused across
+# instances so isinstance/type-name semantics stay stable)
+_guarded_classes: dict[type, type] = {}
+_guard_mu = threading.Lock()
+
+
+def _make_guarded(cls: type) -> type:
+    base_setattr = cls.__setattr__
+
+    def __setattr__(self, name, value):
+        REGISTRY.on_write(self, name)
+        base_setattr(self, name, value)
+
+    return type(cls.__name__, (cls,), {
+        "__setattr__": __setattr__,
+        "__module__": cls.__module__,
+        "__qualname__": cls.__qualname__,
+        "_kubeinfer_lockset_guarded": True,
+    })
+
+
+def guard(obj, ignore=()):
+    """Start intercepting attribute writes on ``obj``. Idempotent.
+
+    Call at the END of ``__init__`` (via ``racecheck.guard``) so
+    pre-sharing construction writes stay out of the state machine —
+    Eraser's EXCLUSIVE state would absorb them anyway, but only for
+    the constructing thread."""
+    cls = type(obj)
+    if getattr(cls, "_kubeinfer_lockset_guarded", False):
+        REGISTRY.register(obj, ignore)
+        return obj
+    with _guard_mu:
+        sub = _guarded_classes.get(cls)
+        if sub is None:
+            sub = _guarded_classes[cls] = _make_guarded(cls)
+    obj.__class__ = sub
+    REGISTRY.register(obj, ignore)
+    return obj
+
+
+def note_read(obj, attr: str) -> None:
+    REGISTRY.note_read(obj, attr)

@@ -1,4 +1,5 @@
-"""Metric-name convention AST pass (rule ``metric-name``).
+"""Metric-name and label convention AST pass (rules ``metric-name``,
+``metric-label``).
 
 Prometheus names are the repo's public observability API: dashboards
 and alert rules key on them, and renames are silent breakage (the old
@@ -51,6 +52,52 @@ _GAUGE_SUFFIXES = _UNIT_SUFFIXES + (
 )
 
 _KINDS = ("Counter", "Gauge", "Histogram")
+
+# Label names live in every alert expression and aggregation clause:
+# same grammar as names minus the namespace prefix, lower snake only.
+_LABEL_RE = re.compile(r"^[a-z_]+$")
+
+# High-cardinality keys: one series PER VALUE, and these take a fresh
+# value per request/trace/prompt — the registry would grow without
+# bound and every scrape would ship it. The check is by label NAME
+# (the value is runtime data the linter cannot see).
+_HIGH_CARDINALITY = {
+    "request_id", "req_id", "trace_id", "span_id", "prompt", "token",
+    "tokens", "user", "user_id", "session", "session_id", "uuid", "url",
+}
+
+# labels= position in each kind's constructor (metrics/registry.py:
+# Histogram takes buckets as positional 2, pushing labels to 3)
+_LABELS_ARG_POS = {"Counter": 2, "Gauge": 2, "Histogram": 3}
+
+
+def _check_labels(kind: str, node: ast.Call):
+    """Yield (message,) violations for the construction's labels."""
+    labels = None
+    for k in node.keywords:
+        if k.arg == "labels":
+            labels = k.value
+    if labels is None:
+        pos = _LABELS_ARG_POS[kind]
+        if len(node.args) > pos:
+            labels = node.args[pos]
+    if labels is None:
+        return
+    if not isinstance(labels, (ast.Tuple, ast.List)):
+        yield (f"{kind} labels must be a literal tuple/list (computed "
+               "label sets cannot be audited for cardinality)")
+        return
+    for el in labels.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+            yield f"{kind} label names must be literal strings"
+            continue
+        lab = el.value
+        if not _LABEL_RE.match(lab):
+            yield (f"{kind} label {lab!r} must match [a-z_]+ "
+                   "(lower snake case, no digits)")
+        elif lab in _HIGH_CARDINALITY:
+            yield (f"{kind} label {lab!r} is high-cardinality (one "
+                   "series per value); aggregate or move it to traces")
 
 
 def _check(kind: str, name: str) -> str | None:
@@ -108,6 +155,10 @@ class _Visitor(ast.NodeVisitor):
                     self.path, node.lineno, "metric-name",
                     f"{kind} name must be a literal string (computed "
                     "names cannot be grepped from alert rules)",
+                ))
+            for msg in _check_labels(kind, node):
+                self.findings.append(Finding(
+                    self.path, node.lineno, "metric-label", msg,
                 ))
         self.generic_visit(node)
 

@@ -1,4 +1,4 @@
-"""Runtime lock-order sentinel (armed by ``KUBEINFER_RACECHECK=1``).
+"""Runtime lock sentinel (armed by ``KUBEINFER_RACECHECK=1`` or ``=2``).
 
 The static lock-discipline pass (analysis/lockcheck.py) proves that
 attributes guarded by a lock are never written outside it, but it cannot
@@ -12,9 +12,25 @@ acquired b while holding a. A cycle in that graph is deadlock
 which is exactly what a chaos tier wants (the hang itself is a
 one-in-a-thousand schedule; the edge pair is deterministic).
 
-Also records per-lock max held duration and acquisition counts, so a
-lock held across a jit compile (the batching stop()-vs-compile hazard)
-shows up as a number, not a hunch.
+Also records per-lock held-duration stats (a bounded reservoir, so a
+week-long soak costs the same memory as one scenario) and acquisition
+counts, so a lock held across a jit compile (the batching
+stop()-vs-compile hazard) shows up as a number, not a hunch.
+
+Level 2 (``KUBEINFER_RACECHECK=2``) additionally feeds the Eraser-style
+lockset race detector (analysis/lockset.py): the tracked per-thread
+held stack IS the lockset that ``guard()``-registered objects intersect
+on every attribute write. This module stays the cheap import leaf —
+lockset is imported lazily and only at level 2.
+
+Two hook surfaces let other analysis tools piggyback on the same
+factories without this module importing them:
+
+- ``set_scheduler_shim(shim)``: the deterministic schedule fuzzer
+  (analysis/schedfuzz.py) interposes on acquire/release so every lock
+  operation becomes a serialized, seeded yield point;
+- ``fuzz_yield(label)``: non-lock yield points (fault-point firings)
+  route through the same shim.
 
 Off (the default) the factories return plain ``threading`` primitives —
 zero overhead in production. The chaos tier (tests/test_chaos.py) arms
@@ -26,24 +42,107 @@ No reference-file citation: the reference has no race tooling at all
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 import traceback
+import zlib
 
 __all__ = [
     "armed",
+    "level",
+    "guard",
     "make_lock",
     "make_rlock",
     "make_condition",
     "TrackedLock",
     "REGISTRY",
+    "set_scheduler_shim",
+    "fuzz_yield",
 ]
 
 
+def level() -> int:
+    """Sentinel level: 0 off, 1 lock-order graph, 2 adds the lockset
+    race detector. Checked at lock CREATION time (and at ``guard()``
+    time), so the env var must be set before the component is built."""
+    v = os.environ.get("KUBEINFER_RACECHECK", "")
+    if v in ("", "0", "false"):
+        return 0
+    try:
+        return max(1, int(v))
+    except ValueError:
+        return 1
+
+
 def armed() -> bool:
-    """Whether the sentinel is on (checked at lock CREATION time, so the
-    env var must be set before the guarded component is constructed)."""
-    return os.environ.get("KUBEINFER_RACECHECK", "") not in ("", "0", "false")
+    """Whether the sentinel is on at any level."""
+    return level() > 0
+
+
+def guard(obj, ignore=()):
+    """Register ``obj`` with the lockset race detector — no-op below
+    level 2, so components can call this unconditionally at the end of
+    ``__init__`` for the price of one env read. ``ignore`` names
+    attributes with a documented benign-race story (single-writer
+    flags, GIL-atomic publishes); each entry deserves a comment at the
+    call site saying why."""
+    if level() < 2:
+        return obj
+    from kubeinfer_tpu.analysis import lockset
+
+    return lockset.guard(obj, ignore=ignore)
+
+
+# --- schedule-fuzzer shim ---------------------------------------------------
+# analysis/schedfuzz.py installs itself here while a fuzz run is live so
+# TrackedLock acquire/release and fault-point firings become scheduler
+# yield points. One global read + None test when inactive.
+
+_SCHED_SHIM = None
+
+
+def set_scheduler_shim(shim) -> None:
+    global _SCHED_SHIM
+    _SCHED_SHIM = shim
+
+
+def fuzz_yield(label: str) -> None:
+    """A non-lock yield point (fault-point firings); no-op unless a
+    schedule-fuzz run is live AND the calling thread is controlled."""
+    shim = _SCHED_SHIM
+    if shim is not None:
+        shim.yield_point(label)
+
+
+class _HoldStats:
+    """Bounded reservoir of one lock's hold durations (Vitter's
+    algorithm R, cap ``CAP``) — a soak run costs the same memory as one
+    scenario. The replacement RNG is seeded from the lock NAME, so
+    which samples survive is a pure function of the observed duration
+    sequence: thread ids (which the OS reuses) never influence it."""
+
+    CAP = 64
+    __slots__ = ("count", "max", "total", "samples", "_rng")
+
+    def __init__(self, name: str) -> None:
+        self.count = 0
+        self.max = 0.0
+        self.total = 0.0
+        self.samples: list[float] = []
+        self._rng = random.Random(zlib.crc32(name.encode()))
+
+    def add(self, d: float) -> None:
+        self.count += 1
+        self.total += d
+        if d > self.max:
+            self.max = d
+        if len(self.samples) < self.CAP:
+            self.samples.append(d)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.CAP:
+                self.samples[j] = d
 
 
 class _Registry:
@@ -52,7 +151,10 @@ class _Registry:
     The graph is keyed by lock *name* (the creation-site label), not
     instance: two Store instances' ``_lock``s are the same node, which
     is the right granularity for order discipline — the code path, not
-    the object, defines the ordering contract.
+    the object, defines the ordering contract. Per-thread held stacks
+    live in ``threading.local`` (keyed by thread OBJECT, not ident), so
+    OS-level thread-id reuse cannot pair one thread's acquire with
+    another's release.
     """
 
     def __init__(self) -> None:
@@ -60,7 +162,7 @@ class _Registry:
         self._mu = threading.Lock()
         # (outer_name, inner_name) -> one example acquisition stack
         self._edges: dict[tuple[str, str], str] = {}
-        self._hold_max: dict[str, float] = {}
+        self._hold: dict[str, _HoldStats] = {}
         self._acquires: dict[str, int] = {}
         self._held = threading.local()
 
@@ -71,6 +173,14 @@ class _Registry:
         if st is None:
             st = self._held.stack = []
         return st
+
+    def held(self) -> list[tuple[int, str]]:
+        """(lock id, name) pairs the CALLING thread currently holds,
+        outermost first — the lockset the Eraser detector (lockset.py)
+        intersects on every guarded attribute write. Ids (not names)
+        carry the mutual-exclusion claim: two Store instances' same-
+        named ``_lock``s do not protect each other's fields."""
+        return [(id(lk), lk.name) for lk, _t0 in self._stack()]
 
     def on_acquired(self, lock: "TrackedLock") -> None:
         st = self._stack()
@@ -106,8 +216,10 @@ class _Registry:
                 held_for = time.monotonic() - st[i][1]
                 del st[i]
                 with self._mu:
-                    if held_for > self._hold_max.get(lock.name, 0.0):
-                        self._hold_max[lock.name] = held_for
+                    hs = self._hold.get(lock.name)
+                    if hs is None:
+                        hs = self._hold[lock.name] = _HoldStats(lock.name)
+                    hs.add(held_for)
                 return
 
     # -- reporting --------------------------------------------------------
@@ -118,11 +230,18 @@ class _Registry:
 
     def cycles(self) -> list[list[str]]:
         """Cycles in the acquisition-order graph (each a node list with
-        the start repeated at the end). Any cycle = deadlock potential."""
+        the start repeated at the end). Any cycle = deadlock potential.
+
+        Deterministic by construction: adjacency and DFS roots are
+        sorted, and each cycle is rotated to start at its smallest
+        node — the report is a pure function of the edge SET, never of
+        the interleaving (or thread-id reuse) that inserted the edges.
+        """
         with self._mu:
-            adj: dict[str, list[str]] = {}
-            for a, b in self._edges:
-                adj.setdefault(a, []).append(b)
+            edges = sorted(self._edges)
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
         out: list[list[str]] = []
         seen_cycles: set[tuple[str, ...]] = set()
         visiting: list[str] = []
@@ -134,19 +253,22 @@ class _Registry:
             on_path.add(node)
             for nxt in adj.get(node, ()):
                 if nxt in on_path:
-                    cyc = visiting[visiting.index(nxt):] + [nxt]
-                    # canonicalize so A→B→A and B→A→B dedupe
-                    canon = tuple(sorted(cyc[:-1]))
+                    cyc = visiting[visiting.index(nxt):]
+                    # canonicalize: dedupe rotations, then anchor the
+                    # reported cycle at its smallest node
+                    canon = tuple(sorted(cyc))
                     if canon not in seen_cycles:
                         seen_cycles.add(canon)
-                        out.append(cyc)
+                        pivot = cyc.index(min(cyc))
+                        rot = cyc[pivot:] + cyc[:pivot]
+                        out.append(rot + [rot[0]])
                 elif nxt not in done:
                     dfs(nxt)
             on_path.discard(node)
             visiting.pop()
             done.add(node)
 
-        for node in list(adj):
+        for node in sorted(adj):
             if node not in done:
                 dfs(node)
         return out
@@ -157,14 +279,21 @@ class _Registry:
             return {
                 "edges": sorted(self._edges),
                 "cycles": cycles,
-                "hold_max_s": dict(self._hold_max),
+                "hold_max_s": {n: h.max for n, h in self._hold.items()},
+                "hold_mean_s": {
+                    n: h.total / h.count
+                    for n, h in self._hold.items() if h.count
+                },
+                "hold_samples": {
+                    n: list(h.samples) for n, h in self._hold.items()
+                },
                 "acquires": dict(self._acquires),
             }
 
     def reset(self) -> None:
         with self._mu:
             self._edges.clear()
-            self._hold_max.clear()
+            self._hold.clear()
             self._acquires.clear()
         # held stacks are thread-local snapshots of LIVE state; resetting
         # mid-hold would corrupt pairing, so only the aggregates clear
@@ -187,6 +316,14 @@ class TrackedLock:
         self._inner = factory()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        shim = _SCHED_SHIM
+        if shim is not None:
+            # schedule-fuzz run live: controlled threads acquire through
+            # the serializing scheduler (returns None for uncontrolled
+            # threads, which fall through to the plain path)
+            res = shim.intercept_acquire(self, blocking, timeout)
+            if res is not None:
+                return res
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             REGISTRY.on_acquired(self)
@@ -195,6 +332,9 @@ class TrackedLock:
     def release(self) -> None:
         self._inner.release()
         REGISTRY.on_released(self)
+        shim = _SCHED_SHIM
+        if shim is not None:
+            shim.notify_release(self)
 
     def locked(self) -> bool:
         return self._inner.locked()

@@ -1,0 +1,591 @@
+"""Deterministic schedule fuzzer (CHESS/Coyote-style, seeded).
+
+The bugs the chaos tier misses are SCHEDULE bugs: with free-running
+threads, the OS explores a handful of interleavings near the happy
+path, the same ones every run. This module serializes the threads of a
+scenario — exactly ONE controlled thread runs at a time — and makes
+every tracked-lock acquire/release and every fault-point firing a yield
+point where a seeded RNG picks who runs next. That buys three things
+real threads cannot give:
+
+- **coverage**: N seeds explore N genuinely different interleavings per
+  scenario, including convoy and handoff orders the OS never schedules;
+- **replay**: the whole run is a pure function of (scenario, seed), so
+  a failure's printed ``seed`` + schedule trace reproduces it
+  byte-for-byte — no "flaky, cannot reproduce" class of bug;
+- **oracles**: after each run the lockset detector (lockset.py) and the
+  lock-order graph (racecheck.py) are consulted, so a schedule that
+  *silently* raced still fails the run.
+
+Interposition is racecheck's scheduler-shim hook: ``TrackedLock``
+routes acquire/release through ``intercept_acquire``/``notify_release``
+while a run is live, and ``FaultRegistry.fire`` calls ``fuzz_yield``.
+Uncontrolled threads (pytest's main thread, any daemon) fall through to
+the plain path untouched.
+
+Deliberate limits: controlled threads must coordinate ONLY through
+tracked locks and computation — a controlled thread that parks on an
+untracked primitive (``queue.get``, ``Event.wait``, ``Condition.wait``)
+blocks the single running slot and the run aborts on the watchdog.
+Scenario bodies below are written to that rule.
+
+CLI: ``python -m kubeinfer_tpu.analysis.schedfuzz --schedules 8`` runs
+every built-in scenario under ``KUBEINFER_RACECHECK=2``; any failure
+prints the scenario, seed, and schedule trace, and
+``--scenario NAME --seed S`` replays exactly that run.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from kubeinfer_tpu.analysis import racecheck
+
+__all__ = ["SchedFuzzer", "Scenario", "SCENARIOS", "run_scenario", "main"]
+
+READY, RUNNING, BLOCKED, DONE = "ready", "running", "blocked", "done"
+
+
+class DeadlockError(Exception):
+    """Every controlled thread is blocked on a tracked lock — the
+    schedule found a real deadlock, not a timeout artifact."""
+
+
+class _Ctl:
+    __slots__ = ("name", "thread", "status", "waiting_on", "exc")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.thread: threading.Thread | None = None
+        self.status = READY
+        self.waiting_on: object | None = None
+        self.exc: BaseException | None = None
+
+
+class SchedFuzzer:
+    """One seeded run: spawn controlled threads, serialize them at yield
+    points, record the schedule. Install as racecheck's scheduler shim
+    for the duration of ``run()`` only."""
+
+    def __init__(self, seed: int, schedule: list[str] | None = None) -> None:
+        self.seed = seed
+        self._rng = random.Random(seed)
+        # replay mode: consume a recorded schedule instead of the RNG
+        self._replay = list(schedule) if schedule is not None else None
+        self._replay_pos = 0
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._ctls: dict[str, _Ctl] = {}
+        self._by_thread: dict[threading.Thread, _Ctl] = {}
+        # lock id -> (owner ctl, reentry count): the shim's own view of
+        # ownership — the inner primitive is only taken when this map
+        # says the lock is free, so the inner acquire can never block
+        self._owners: dict[int, tuple[_Ctl, int]] = {}
+        self._waiters: dict[int, list[_Ctl]] = {}
+        self.schedule: list[str] = []  # chosen thread per decision
+        self.trace: list[tuple[str, str]] = []  # (thread, yield label)
+        self._aborted: BaseException | None = None
+
+    # -- scenario-facing API ----------------------------------------------
+
+    def spawn(self, name: str, fn, *args) -> None:
+        ctl = _Ctl(name)
+        self._ctls[name] = ctl
+
+        def body() -> None:
+            with self._cv:
+                while ctl.status != RUNNING and self._aborted is None:
+                    self._cv.wait()
+            if self._aborted is not None:
+                return
+            try:
+                fn(*args)
+            except BaseException as e:  # noqa: BLE001 — reported, not hidden
+                ctl.exc = e
+            with self._cv:
+                ctl.status = DONE
+                self._pick_next()
+
+        t = threading.Thread(target=body, name=name, daemon=True)
+        ctl.thread = t
+        self._by_thread[t] = ctl
+
+    def run(self) -> None:
+        """Start all spawned threads and drive them to completion.
+        Raises the first scenario exception or DeadlockError."""
+        racecheck.set_scheduler_shim(self)
+        try:
+            for ctl in self._ctls.values():
+                ctl.thread.start()
+            with self._cv:
+                self._pick_next()
+                while (self._aborted is None
+                       and any(c.status != DONE
+                               for c in self._ctls.values())):
+                    # watchdog: a controlled thread parked on an
+                    # UNTRACKED primitive starves the running slot; 10s
+                    # of zero progress can only mean that (all compute
+                    # here is microseconds)
+                    if not self._cv.wait(timeout=10.0):
+                        self._aborted = RuntimeError(
+                            "schedfuzz watchdog: no progress — a "
+                            "controlled thread blocked on an untracked "
+                            "primitive"
+                        )
+                        self._cv.notify_all()
+            for ctl in self._ctls.values():
+                ctl.thread.join(timeout=2.0)
+        finally:
+            racecheck.set_scheduler_shim(None)
+        if self._aborted is not None:
+            raise self._aborted
+        for ctl in self._ctls.values():
+            if ctl.exc is not None:
+                raise ctl.exc
+
+    # -- scheduler core (callers hold _cv) --------------------------------
+
+    def _pick_next(self) -> None:
+        ready = sorted(n for n, c in self._ctls.items()
+                       if c.status == READY)
+        if not ready:
+            blocked = sorted(n for n, c in self._ctls.items()
+                             if c.status == BLOCKED)
+            if blocked and self._aborted is None:
+                locks = {n: getattr(self._ctls[n].waiting_on, "name", "?")
+                         for n in blocked}
+                self._aborted = DeadlockError(
+                    f"all controlled threads blocked: {locks}"
+                )
+            self._cv.notify_all()
+            return
+        if self._replay is not None and self._replay_pos < len(self._replay):
+            choice = self._replay[self._replay_pos]
+            self._replay_pos += 1
+            if choice not in ready:
+                self._aborted = RuntimeError(
+                    f"replay divergence at step {self._replay_pos}: "
+                    f"schedule says {choice!r}, ready set is {ready}"
+                )
+                self._cv.notify_all()
+                return
+        else:
+            choice = ready[self._rng.randrange(len(ready))]
+        self.schedule.append(choice)
+        self._ctls[choice].status = RUNNING
+        self._cv.notify_all()
+
+    def _park_until_running(self, ctl: _Ctl) -> None:
+        while ctl.status != RUNNING and self._aborted is None:
+            self._cv.wait()
+        if self._aborted is not None:
+            raise self._aborted
+
+    def _yield_locked(self, ctl: _Ctl, label: str) -> None:
+        self.trace.append((ctl.name, label))
+        ctl.status = READY
+        self._pick_next()
+        self._park_until_running(ctl)
+
+    # -- shim surface (called from racecheck/faultpoints) -----------------
+
+    def yield_point(self, label: str) -> None:
+        ctl = self._by_thread.get(threading.current_thread())
+        if ctl is None:
+            return
+        with self._cv:
+            self._yield_locked(ctl, label)
+
+    def intercept_acquire(self, lock, blocking: bool, timeout: float):
+        """Serialized acquire for controlled threads; None hands an
+        uncontrolled caller back to the plain path."""
+        ctl = self._by_thread.get(threading.current_thread())
+        if ctl is None:
+            return None
+        lid = id(lock)
+        with self._cv:
+            self._yield_locked(ctl, f"acquire:{lock.name}")
+            while True:
+                owner = self._owners.get(lid)
+                if owner is None:
+                    self._owners[lid] = (ctl, 1)
+                    break
+                if owner[0] is ctl:
+                    # RLock reentry; a plain Lock would self-deadlock
+                    # here, which the scenario would have to be wrong
+                    # to do — count it rather than hang the run
+                    self._owners[lid] = (ctl, owner[1] + 1)
+                    break
+                if not blocking:
+                    return False
+                ctl.status = BLOCKED
+                ctl.waiting_on = lock
+                self._waiters.setdefault(lid, []).append(ctl)
+                self._pick_next()
+                self._park_until_running(ctl)
+                ctl.waiting_on = None
+        # the shim's owner map says free, so this cannot block
+        lock._inner.acquire()
+        racecheck.REGISTRY.on_acquired(lock)
+        return True
+
+    def notify_release(self, lock) -> None:
+        ctl = self._by_thread.get(threading.current_thread())
+        if ctl is None:
+            return
+        lid = id(lock)
+        with self._cv:
+            owner = self._owners.get(lid)
+            if owner is not None and owner[0] is ctl:
+                if owner[1] > 1:
+                    self._owners[lid] = (ctl, owner[1] - 1)
+                else:
+                    del self._owners[lid]
+                    for w in self._waiters.pop(lid, ()):  # noqa: B020
+                        if w.status == BLOCKED:
+                            w.status = READY
+            # a release is a decision point too: whether the releaser
+            # keeps running or a freed waiter jumps in IS the bug space
+            # (convoy vs barging) — yield here to explore both
+            self._yield_locked(ctl, f"release:{lock.name}")
+
+
+# --- scenarios ---------------------------------------------------------------
+
+
+class Scenario:
+    """name + builder; the builder receives a SchedFuzzer and spawns
+    the scenario's threads, returning a verify() callable run after the
+    schedule completes (exceptions there fail the run)."""
+
+    def __init__(self, name: str, build) -> None:
+        self.name = name
+        self.build = build
+
+
+def _scn_store_churn(fz: SchedFuzzer):
+    from kubeinfer_tpu.controlplane.store import AlreadyExistsError, \
+        NotFoundError, Store
+
+    store = Store()
+
+    def writer(i: int) -> None:
+        for k in range(4):
+            name = f"w{i}-{k}"
+            store.create("pods", {"metadata": {"name": name},
+                                  "spec": {"i": i}})
+        # contended key: both writers race the create/delete pair
+        try:
+            store.create("pods", {"metadata": {"name": "shared"},
+                                  "spec": {"i": i}})
+        except AlreadyExistsError:
+            pass
+        try:
+            store.delete("pods", "shared")
+        except NotFoundError:
+            pass
+
+    def reader() -> None:
+        for _ in range(6):
+            try:
+                store.get("pods", "shared")
+            except NotFoundError:
+                pass
+            store.list("pods")
+
+    fz.spawn("writer-0", writer, 0)
+    fz.spawn("writer-1", writer, 1)
+    fz.spawn("reader", reader)
+
+    def verify() -> None:
+        names = {o["metadata"]["name"] for o in store.list("pods")}
+        assert {f"w{i}-{k}" for i in (0, 1) for k in range(4)} <= names
+        rvs = [o["metadata"]["resourceVersion"] for o in store.list("pods")]
+        assert len(rvs) == len(set(rvs)), "duplicate resourceVersion"
+    return verify
+
+
+def _scn_breaker_storm(fz: SchedFuzzer):
+    from kubeinfer_tpu.resilience import CircuitBreaker
+
+    br = CircuitBreaker(edge="fuzz", failure_threshold=3,
+                        reset_timeout_s=0.0)
+
+    def failer() -> None:
+        for _ in range(5):
+            br.allow()
+            br.record_failure()
+
+    def succeeder() -> None:
+        for _ in range(5):
+            if br.allow():
+                br.record_success()
+
+    fz.spawn("failer-0", failer)
+    fz.spawn("failer-1", failer)
+    fz.spawn("succeeder", succeeder)
+
+    def verify() -> None:
+        assert br.state in ("closed", "open", "half-open"), br.state
+    return verify
+
+
+def _scn_pool_churn(fz: SchedFuzzer):
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool
+
+    pool = BlockPool(32, 4)
+
+    def churn(_i: int) -> None:
+        for _ in range(4):
+            blocks = pool.alloc(2)
+            pool.ref(blocks)
+            pool.unref(blocks)
+            pool.unref(blocks)
+
+    fz.spawn("churn-0", churn, 0)
+    fz.spawn("churn-1", churn, 1)
+    fz.spawn("churn-2", churn, 2)
+
+    def verify() -> None:
+        assert pool.free_blocks == 31, pool.free_blocks
+        assert pool.used_blocks == 0, pool.used_blocks
+    return verify
+
+
+def _scn_radix_churn(fz: SchedFuzzer):
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+
+    pool = BlockPool(64, 4)
+    cache = RadixCache(pool)
+
+    def inserter(base: int) -> None:
+        toks = list(range(base, base + 8))
+        for _ in range(3):
+            got = cache.match(toks)
+            need = 2 - len(got)
+            fresh = pool.alloc(need) if need else []
+            cache.insert(toks, got + fresh)
+            cache.note_result(len(got))
+            # the trie took its own ref on new nodes; drop ours
+            pool.unref(got + fresh)
+
+    def evictor() -> None:
+        for _ in range(4):
+            cache.evictable_blocks()
+            cache.ensure_free(4)
+            cache.stats()
+
+    fz.spawn("insert-0", inserter, 0)
+    fz.spawn("insert-100", inserter, 100)
+    fz.spawn("evictor", evictor)
+
+    def verify() -> None:
+        s = cache.stats()
+        assert s["nodes"] >= 0
+        # every caller balanced its refs: only the trie holds blocks
+        assert pool.used_blocks == s["nodes"], (pool.used_blocks, s)
+    return verify
+
+
+def _scn_router_score(fz: SchedFuzzer):
+    from kubeinfer_tpu.router.core import FleetRouter
+
+    r = FleetRouter()
+    for i in range(3):
+        r.add_replica(f"r{i}", f"http://r{i}")
+        r.update_replica(f"r{i}", {}, age_s=0.0)
+
+    def updater(i: int) -> None:
+        for k in range(4):
+            r.update_replica(f"r{i}", {"queued": k, "running": k % 2},
+                             age_s=0.0)
+
+    def router_thread() -> None:
+        for _ in range(5):
+            d = r.route(list(range(16)))
+            assert d.replica in ("", "r0", "r1", "r2"), d.replica
+
+    fz.spawn("update-0", updater, 0)
+    fz.spawn("update-1", updater, 1)
+    fz.spawn("route", router_thread)
+
+    def verify() -> None:
+        assert len(r.replicas()) == 3
+    return verify
+
+
+def _scn_flight_churn(fz: SchedFuzzer):
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=16, name="schedfuzz.FlightRecorder._lock")
+
+    def noter(i: int) -> None:
+        kind = "submit" if i == 0 else "retire"
+        for k in range(6):
+            fr.note(kind, queue_depth=k)
+
+    def snapper() -> None:
+        for _ in range(4):
+            snap = fr.snapshot()
+            assert len(snap) <= 16
+
+    fz.spawn("note-0", noter, 0)
+    fz.spawn("note-1", noter, 1)
+    fz.spawn("snap", snapper)
+
+    def verify() -> None:
+        assert len(fr.snapshot()) <= 16
+    return verify
+
+
+def _scn_fault_burst(fz: SchedFuzzer):
+    from kubeinfer_tpu.resilience.faultpoints import FaultRegistry, FaultSpec
+
+    reg = FaultRegistry()
+    reg.arm(FaultSpec(point="store.get", mode="error", kind="reset",
+                      rate=1.0, count=2))
+    reg.seed(7)
+
+    def edge(_i: int) -> None:
+        for _ in range(4):
+            try:
+                reg.fire("store.get")
+            except ConnectionResetError:
+                pass
+            reg.fire("store.put")
+
+    fz.spawn("edge-0", edge, 0)
+    fz.spawn("edge-1", edge, 1)
+
+    def verify() -> None:
+        fired = [e for e in reg.log if e[0] == "store.get"]
+        assert len(fired) == 2, reg.log
+    return verify
+
+
+def _scn_registry_scrape(fz: SchedFuzzer):
+    from kubeinfer_tpu.metrics.registry import Counter, Registry
+
+    reg = Registry()
+    c = Counter("kubeinfer_fuzz_ops_total", "fuzz ops", ("op",),
+                registry=reg)
+
+    def inc(i: int) -> None:
+        for _ in range(6):
+            c.inc(f"op{i}")
+
+    def scraper() -> None:
+        for _ in range(4):
+            reg.render()
+
+    fz.spawn("inc-0", inc, 0)
+    fz.spawn("inc-1", inc, 1)
+    fz.spawn("scrape", scraper)
+
+    def verify() -> None:
+        assert c.value("op0") == 6.0 and c.value("op1") == 6.0
+    return verify
+
+
+SCENARIOS = [
+    Scenario("store-churn", _scn_store_churn),
+    Scenario("breaker-storm", _scn_breaker_storm),
+    Scenario("pool-churn", _scn_pool_churn),
+    Scenario("radix-churn", _scn_radix_churn),
+    Scenario("router-score", _scn_router_score),
+    Scenario("flight-churn", _scn_flight_churn),
+    Scenario("fault-burst", _scn_fault_burst),
+    Scenario("registry-scrape", _scn_registry_scrape),
+]
+
+
+def run_scenario(scn: Scenario, seed: int,
+                 schedule: list[str] | None = None) -> SchedFuzzer:
+    """One seeded (or replayed) run with fresh race-oracle state.
+    Raises on scenario exception, deadlock, verify failure, lockset
+    race, or lock-order cycle; returns the fuzzer (trace + schedule)."""
+    from kubeinfer_tpu.analysis import lockset
+
+    racecheck.REGISTRY.reset()
+    lockset.REGISTRY.reset()
+    fz = SchedFuzzer(seed, schedule=schedule)
+    verify = scn.build(fz)
+    fz.run()
+    verify()
+    races = lockset.REGISTRY.races()
+    if races:
+        raise AssertionError(
+            "lockset race under schedule:\n" + lockset.REGISTRY.render()
+        )
+    cycles = racecheck.REGISTRY.cycles()
+    if cycles:
+        raise AssertionError(f"lock-order cycle under schedule: {cycles}")
+    return fz
+
+
+def _out(msg: str) -> None:
+    """CLI report line. This module doubles as the ``python -m
+    kubeinfer_tpu.analysis.schedfuzz`` runner; its stdout (seed +
+    schedule on failure) IS the replay interface, same contract as
+    bench.py's JSON line."""
+    # lint: allow[log-discipline] CLI surface: the printed seed+schedule is the replay contract, not a log line
+    print(msg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="seeded deterministic schedule fuzzer"
+    )
+    ap.add_argument("--schedules", type=int, default=8,
+                    help="seeds per scenario (seed = base + i)")
+    ap.add_argument("--seed", type=int, default=0, help="base seed")
+    ap.add_argument("--scenario", default=None,
+                    help="run only this scenario (with --seed: one "
+                         "replay run printing the full trace)")
+    args = ap.parse_args(argv)
+
+    # arm both race oracles BEFORE any scenario constructs its locks
+    # (factories check the level at creation time)
+    os.environ["KUBEINFER_RACECHECK"] = "2"
+
+    scns = [s for s in SCENARIOS
+            if args.scenario is None or s.name == args.scenario]
+    if not scns:
+        _out(f"unknown scenario {args.scenario!r}; have: "
+              + ", ".join(s.name for s in SCENARIOS))
+        return 2
+    replay_one = args.scenario is not None and args.schedules == 1
+    failures = 0
+    runs = 0
+    for scn in scns:
+        for i in range(args.schedules):
+            seed = args.seed + i
+            runs += 1
+            try:
+                fz = run_scenario(scn, seed)
+            except BaseException as e:  # noqa: BLE001 — CLI reports all
+                failures += 1
+                _out(f"FAIL {scn.name} seed={seed}: {e!r}")
+                _out(f"  replay: python -m kubeinfer_tpu.analysis."
+                      f"schedfuzz --scenario {scn.name} --seed {seed} "
+                      f"--schedules 1")
+                continue
+            if replay_one:
+                _out(f"{scn.name} seed={seed} schedule: "
+                      + ",".join(fz.schedule))
+                for who, label in fz.trace:
+                    _out(f"  {who}: {label}")
+    if failures:
+        _out(f"schedfuzz: {failures}/{runs} runs failed")
+        return 1
+    _out(f"schedfuzz: {runs} runs ok "
+          f"({len(scns)} scenarios x {args.schedules} seeds)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,7 +29,7 @@ import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Iterator
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 
 # Journal entries between snapshot compactions. Control-plane mutation
 # rates are a few per tick, so compaction is rare; the journal stays
@@ -107,6 +107,7 @@ class Store:
             self._journal_f = open(
                 self._data_dir / "journal.jsonl", "a", encoding="utf-8"
             )
+        guard(self)
 
     # -- durability ------------------------------------------------------
 

@@ -41,7 +41,7 @@ from kubeinfer_tpu.controlplane.store import (
 )
 from kubeinfer_tpu.resilience import faultpoints
 from kubeinfer_tpu.utils.clock import Clock, RealClock
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 
 # Store failures a renew tick must survive (see node_agent.py
 # STORE_TRANSIENT: OSError covers urllib errors and the breaker's
@@ -131,6 +131,7 @@ class LeaseManager:
         self._is_leader = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        guard(self)
 
     # -- state machine (election.go:47-69) --------------------------------
 

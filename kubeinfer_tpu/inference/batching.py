@@ -47,7 +47,7 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
 from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
@@ -647,6 +647,7 @@ class ContinuousEngine:
         # scheduler loop and stop()'s cleanup (the join below can time
         # out behind a long jit compile, leaving both threads live)
         self._lock = make_lock("batching.ContinuousEngine._lock")
+        guard(self)
 
     # -- public API -------------------------------------------------------
 
@@ -1052,6 +1053,7 @@ class ContinuousEngine:
                 if not self._prefills or self._prefills[0] is not task:
                     return  # stop() cleared the queue mid-pass
                 self._prefills.pop(0)
+                # lint: allow[blocking-under-lock] the tail-bucket admit compile (tens of seconds cold) deliberately spans _lock: slot tables and the prefill queue must swap atomically vs stop(); deployments prewarm (see class docstring)
                 self._finalize_admit(task)
             return
         window = np.asarray(
@@ -1527,6 +1529,7 @@ class ContinuousEngine:
                     )
                     if kv_plan is None:
                         break  # pool backpressure: hold until a retire
+                    # lint: allow[blocking-under-lock] known ceiling: the admit-path jit compile (cold bucket ~tens of seconds) runs under _lock so stop() sees a consistent slot/pool state; stats_summary went lockless for exactly this reason (PR 6)
                     self._admit(slot, req, kv_plan, tokens)
                     return True
             # front, not back: this was the oldest pending request and

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 from typing import Iterable, Sequence
 
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 
 NULL_BLOCK = 0
 
@@ -114,6 +114,7 @@ class BlockPool:
         # in whatever cache hierarchy the backend has).
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._ref = [0] * self.num_blocks
+        guard(self)
 
     def alloc(self, n: int) -> list[int]:
         """Take n blocks at refcount 1. Raises when the pool cannot
@@ -220,6 +221,7 @@ class RadixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        guard(self)
 
     def _keys(self, tokens: Sequence[int]) -> list[tuple]:
         bs = self._pool.block_size

@@ -62,6 +62,7 @@ def load_native() -> ctypes.CDLL:
         # in place and re-dlopening the same path returns the cached stale
         # handle — only a fresh process would see the rebuild.
         if _stale():
+            # lint: allow[blocking-under-lock] once-per-process cc build (~seconds) must serialize: two racing builders would link a torn .so; callers accept first-load latency
             _build()
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))

@@ -45,7 +45,7 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 from kubeinfer_tpu.metrics.registry import (
     breaker_state,
     breaker_transitions_total,
@@ -247,6 +247,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
+        guard(self)
 
     @property
     def state(self) -> str:

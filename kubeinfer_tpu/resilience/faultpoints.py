@@ -45,7 +45,7 @@ import urllib.error
 from dataclasses import dataclass, field
 
 from kubeinfer_tpu.metrics.registry import fault_injections_total
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import fuzz_yield, make_lock
 from kubeinfer_tpu.observability import tracing
 
 __all__ = ["FaultSpec", "FaultRegistry", "REGISTRY", "fire", "mangle"]
@@ -170,6 +170,9 @@ class FaultRegistry:
 
     def fire(self, point: str, key: str = "") -> None:
         """Action faults (error/latency/blackhole) at a control edge."""
+        # every control edge is an interleaving opportunity for the
+        # schedule fuzzer, armed or not — no-op outside a fuzz run
+        fuzz_yield(f"fault:{point}")
         if not self._specs and self._env_checked:
             return
         with self._mu:

@@ -21,7 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
-from kubeinfer_tpu.analysis.racecheck import make_lock
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 from kubeinfer_tpu.inference.kv_blocks import (
     SUMMARY_FINGERPRINT_BUDGET,
     prefix_fingerprints,
@@ -141,6 +141,7 @@ class FleetRouter:
         self._replicas: dict[str, ReplicaView] = {}
         self._decisions = 0
         self._hits = 0
+        guard(self)
 
     # -- view maintenance ---------------------------------------------------
 

@@ -78,8 +78,9 @@ _COMPONENT_BY_PREFIX = (
     # resilience layer + fault-injection scenarios (`make test-chaos`);
     # pure controlplane work — runs under the same virtual CPU mesh
     (("test_chaos", "test_resilience"), "chaos"),
-    # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/)
-    (("test_static_analysis",), "analysis"),
+    # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/);
+    # the sanitizer file covers the lockset detector + schedule fuzzer
+    (("test_static_analysis", "test_concurrency_sanitizer"), "analysis"),
     # fleet router: scoring/summary round-trips + proxy; its chaos
     # scenario carries an explicit @pytest.mark.chaos on top
     (("test_router",), "router"),
@@ -99,3 +100,32 @@ def pytest_collection_modifyitems(config, items):
                 break
         else:
             item.add_marker(pytest.mark.controlplane)
+
+
+# --- concurrency sanitizer arming (ISSUE 9) ---------------------------------
+# Every chaos-marked test (test_chaos, test_resilience, and router chaos
+# scenarios) runs at KUBEINFER_RACECHECK=2: tracked locks feed the
+# lock-order graph AND guard()-registered objects feed the Eraser
+# lockset detector. Teardown fails the test on either oracle — a race
+# the schedule happened not to lose is still a finding.
+
+import pytest  # noqa: E402 — after the jax mesh setup above
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_armed(request, monkeypatch):
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    monkeypatch.setenv("KUBEINFER_RACECHECK", "2")
+    from kubeinfer_tpu.analysis import lockset, racecheck
+
+    racecheck.REGISTRY.reset()
+    lockset.REGISTRY.reset()
+    yield
+    cycles = racecheck.REGISTRY.cycles()
+    assert not cycles, f"lock-order cycles (deadlock potential): {cycles}"
+    races = lockset.REGISTRY.races()
+    assert not races, (
+        "lockset data races:\n" + lockset.REGISTRY.render()
+    )

@@ -21,7 +21,6 @@ import urllib.request
 import pytest
 
 from kubeinfer_tpu import metrics
-from kubeinfer_tpu.analysis import racecheck
 from kubeinfer_tpu.api.workload import NodeState, Workload
 from kubeinfer_tpu.controlplane.httpstore import RemoteStore, StoreServer
 from kubeinfer_tpu.controlplane.store import Store
@@ -39,17 +38,10 @@ def _clean_faults():
     REGISTRY.disarm()
 
 
-@pytest.fixture(autouse=True)
-def _racecheck_armed(monkeypatch):
-    """Chaos scenarios run with the lock-order sentinel armed: every
-    component constructed in the test gets tracked locks, and the
-    scenario fails if the acquisition-order graph ends with a cycle
-    (deadlock potential the schedule happened not to hit)."""
-    monkeypatch.setenv("KUBEINFER_RACECHECK", "1")
-    racecheck.REGISTRY.reset()
-    yield
-    cycles = racecheck.REGISTRY.cycles()
-    assert not cycles, f"lock-order cycles (deadlock potential): {cycles}"
+# racecheck arming lives in conftest's _sanitizer_armed fixture now:
+# every chaos-marked test (this file, test_resilience, router chaos)
+# runs at KUBEINFER_RACECHECK=2 with lockset + lock-order teardown
+# assertions.
 
 
 def _wait_for(cond, timeout: float = 8.0, interval: float = 0.02) -> bool:

@@ -390,7 +390,9 @@ def test_allow_only_matches_named_rule():
             return x.item()  # lint: allow[jit-dynamic-shape] wrong rule named
         """
     )
-    assert rules_of(fs) == ["jit-host-sync"]
+    # the misnamed allow now ALSO surfaces as a stale suppression: the
+    # named rule never fires on that line
+    assert rules_of(fs) == ["jit-host-sync", "unused-suppression"]
 
 
 # --- lock-discipline --------------------------------------------------------
@@ -815,6 +817,272 @@ def test_metric_name_rule_off_for_test_files():
     """
     assert run_src(src, path="tests/test_metrics.py") == []
     assert rules_of(run_src(src, path="pkg/server.py")) == ["metric-name"]
+
+
+# --- metric-label -----------------------------------------------------------
+
+
+def test_label_case_and_high_cardinality_flagged():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Counter
+
+        c = Counter("kubeinfer_req_total", "reqs",
+                    labels=("Kind", "request_id"))
+        """
+    )
+    assert rules_of(fs) == ["metric-label", "metric-label"]
+    msgs = " ".join(f.message for f in fs)
+    assert "'Kind'" in msgs and "high-cardinality" in msgs
+
+
+def test_histogram_positional_labels_checked():
+    # Histogram's constructor takes buckets as positional 2, pushing the
+    # labels tuple to positional 3 — the pass must look there, not at 2.
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Histogram
+
+        h = Histogram("kubeinfer_wait_seconds", "wait", (0.1, 1.0),
+                      ("trace_id",))
+        """
+    )
+    assert rules_of(fs) == ["metric-label"]
+    assert "high-cardinality" in fs[0].message
+
+
+def test_computed_label_set_flagged_literal_clean():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.metrics.registry import Gauge
+
+        LABELS = ("kind",)
+        g = Gauge("kubeinfer_queue_depth", "depth", labels=LABELS)
+        ok = Gauge("kubeinfer_pool_free", "free", labels=("kind", "node"))
+        """
+    )
+    assert rules_of(fs) == ["metric-label"]
+    assert "literal tuple/list" in fs[0].message
+
+
+# --- blocking-under-lock ----------------------------------------------------
+
+
+def test_sleep_under_lock_flagged_direct():
+    fs = run_src(
+        """
+        import time
+        from kubeinfer_tpu.analysis.racecheck import make_lock
+
+        class Poller:
+            def __init__(self):
+                self._lock = make_lock("poller")
+
+            def wait(self):
+                with self._lock:
+                    time.sleep(0.5)
+        """
+    )
+    assert rules_of(fs) == ["blocking-under-lock"]
+    assert "time.sleep()" in fs[0].message
+    # direct findings land on the blocking line itself
+    assert fs[0].line == 11
+
+
+def test_transitive_block_lands_on_call_under_lock():
+    fs = run_src(
+        """
+        import subprocess
+        from kubeinfer_tpu.analysis.racecheck import make_lock
+
+        class Builder:
+            def __init__(self):
+                self._lock = make_lock("builder")
+
+            def _compile(self):
+                subprocess.run(["cc", "x.c"])
+
+            def build(self):
+                with self._lock:
+                    self._compile()
+        """
+    )
+    assert rules_of(fs) == ["blocking-under-lock"]
+    # the suppression/fix point is where the lock scope is chosen — the
+    # call line — not the callee's subprocess line
+    assert fs[0].line == 14
+    assert "_compile()" in fs[0].message
+
+
+def test_jit_dispatch_under_lock_flagged_via_registry():
+    fs = run_src(
+        """
+        from kubeinfer_tpu.analysis.racecheck import make_lock
+
+        class Engine:
+            def __init__(self):
+                self._lock = make_lock("engine")
+
+            def admit(self, x):
+                with self._lock:
+                    return step_fn(x)
+        """,
+        jit_registry={"step_fn": frozenset()},
+    )
+    assert rules_of(fs) == ["blocking-under-lock"]
+    assert "jit dispatch" in fs[0].message
+
+
+def test_blocking_outside_lock_and_init_clean():
+    fs = run_src(
+        """
+        import time
+        from kubeinfer_tpu.analysis.racecheck import make_lock
+
+        class Warmup:
+            def __init__(self):
+                self._lock = make_lock("warm")
+                with self._lock:
+                    # nothing shares the object mid-__init__
+                    time.sleep(0.01)
+
+            def tick(self):
+                time.sleep(0.1)
+                with self._lock:
+                    self.n = 1
+        """
+    )
+    assert fs == []
+
+
+def test_blockcheck_off_for_test_files():
+    src = """
+    import time
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+
+    _mu = make_lock("fixture")
+
+    def poll():
+        with _mu:
+            time.sleep(0.01)
+    """
+    assert run_src(src, path="tests/test_fixture.py") == []
+    assert rules_of(run_src(src, path="pkg/poll.py")) == [
+        "blocking-under-lock"]
+
+
+def test_blocking_under_lock_allow_suppresses():
+    fs = run_src(
+        """
+        import time
+        from kubeinfer_tpu.analysis.racecheck import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s")
+
+            def settle(self):
+                with self._lock:
+                    # lint: allow[blocking-under-lock] 10ms debounce is the accepted ceiling
+                    time.sleep(0.01)
+        """
+    )
+    assert fs == []
+
+
+# --- unused-suppression -----------------------------------------------------
+
+
+def test_stale_allow_is_a_finding():
+    fs = run_src(
+        """
+        # lint: allow[jit-host-sync] left behind after a refactor
+        x = 1
+        """
+    )
+    assert rules_of(fs) == ["unused-suppression"]
+    # lands on the comment's own line — that's the line to delete
+    assert fs[0].line == 2
+    assert "allow[jit-host-sync]" in fs[0].message
+
+
+def test_consumed_allow_is_not_stale():
+    fs = run_src(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            # lint: allow[jit-host-sync] fixture: proving consumption
+            return x.item()
+        """
+    )
+    assert fs == []
+
+
+def test_unused_suppression_is_unsuppressable():
+    # allow[unused-suppression] neither hides the stale finding nor is
+    # itself exempt from staleness — both comment lines get reported
+    fs = run_src(
+        """
+        # lint: allow[unused-suppression] trying to hide staleness
+        # lint: allow[metric-name] stale after rename
+        x = 1
+        """
+    )
+    assert rules_of(fs) == ["unused-suppression", "unused-suppression"]
+
+
+def test_bare_and_unknown_allows_not_double_reported():
+    # bare/unknown allows already carry their own meta finding; the
+    # staleness pass must not pile a second finding on the same comment
+    fs = run_src(
+        """
+        # lint: allow[jit-host-sync]
+        x = 1
+        y = 2  # lint: allow[not-a-rule] reasoned but bogus
+        """
+    )
+    assert rules_of(fs) == ["lint-bare-allow", "lint-unknown-rule"]
+
+
+# --- racecheck reservoir + cycle determinism --------------------------------
+
+
+def test_hold_stats_reservoir_bounded_and_deterministic():
+    a = racecheck._HoldStats("pool.lock")
+    b = racecheck._HoldStats("pool.lock")
+    for i in range(500):
+        a.add(float(i))
+        b.add(float(i))
+    assert a.count == 500
+    assert a.max == 499.0
+    assert len(a.samples) == a.CAP
+    # name-seeded replacement RNG: which samples survive is a pure
+    # function of the duration sequence, so two identical runs agree
+    assert a.samples == b.samples
+    # a different lock name seeds differently (same sequence, different
+    # survivors) — proves the seed actually comes from the name
+    c = racecheck._HoldStats("store.lock")
+    for i in range(500):
+        c.add(float(i))
+    assert c.samples != a.samples
+
+
+def test_cycle_report_independent_of_edge_insertion_order():
+    def build(order):
+        reg = racecheck._Registry()
+        locks = {n: racecheck.TrackedLock(n) for n in "abc"}
+        for outer, inner in order:
+            reg.on_acquired(locks[outer])
+            reg.on_acquired(locks[inner])
+            reg.on_released(locks[inner])
+            reg.on_released(locks[outer])
+        return reg.cycles()
+
+    fwd = build([("a", "b"), ("b", "c"), ("c", "a")])
+    rev = build([("c", "a"), ("b", "c"), ("a", "b")])
+    assert fwd == rev == [["a", "b", "c", "a"]]
 
 
 # --- the tier-1 gate --------------------------------------------------------
