@@ -834,6 +834,110 @@ def serving_slo_bench(n_slots=4, cache_len=1024, model="bench-280m",
     }
 
 
+def decode_window_bench(short_new=8, long_new=104, prompt_len=32,
+                        n_slots=32, cache_len=256, model="tiny",
+                        reps=3):
+    """Dispatch-amortization phase: B=32 continuous decode through K=8
+    fused windows vs the K=1 single-step loop.
+
+    The quantity under test is the per-dispatch FLOOR (Python
+    scheduler pass + jit call + transport round trip on the relay +
+    readback sync), not model compute — so this phase deliberately
+    uses the ``tiny`` preset, where compute per step is ~0 and the
+    floor is all there is. On the axon relay the floor is the ~70-130
+    ms transport tax and K=8 buys back ~7/8 of it; on the CPU fallback
+    the floor is the scheduler pass itself and the headline is the
+    dispatch count, not wall time — hence the paired
+    ``decode_dispatches_per_token`` key (1.0 for the single-step loop,
+    1/K for fused windows).
+
+    Both figures are chain-differenced between a long and a short run
+    of the SAME batch (the device_solve_ms trick): the prefill phase,
+    the admission stagger, and the horizon ramp are identical in both
+    runs and cancel, leaving pure steady-state decode — tokens/s from
+    the wall-time delta, dispatches/token from a StepProfiler seq
+    cursor bracket around each run.
+    """
+    import jax
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+    cfg = PRESETS[model]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_slots)
+    ]
+    steps = n_slots * (long_new - short_new)
+
+    def _phase(max_window):
+        eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            max_window=max_window,
+        ).start()
+        try:
+            def _run(max_new):
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts
+                ]
+                for r in reqs:
+                    if not r.done.wait(timeout=300):
+                        raise TimeoutError("window-phase request hung")
+                return time.perf_counter() - t0
+
+            def _cursor():
+                prof = eng.profiler.snapshot()
+                return prof[-1].seq if prof else -1
+
+            def _decode_counts(since, upto=None):
+                recs = [
+                    r for r in eng.profiler.snapshot(since_seq=since)
+                    if r.phase == "decode"
+                    and (upto is None or r.seq <= upto)
+                ]
+                return len(recs), sum(r.steps for r in recs)
+
+            _run(short_new)  # compile both shapes
+            _run(long_new)
+            _touch_progress()
+            shorts, longs = [], []
+            for _ in range(reps):
+                shorts.append(_run(short_new))
+                longs.append(_run(long_new))
+                _touch_progress()
+            # unhurried final pair with cursors between: the dispatch
+            # ratio differences the long run's decode records against
+            # the short run's, cancelling admission-phase K=1 passes
+            c1 = _cursor()
+            _run(short_new)
+            c2 = _cursor()
+            _run(long_new)
+            d_s, s_s = _decode_counts(c1, upto=c2)
+            d_l, s_l = _decode_counts(c2)
+            dt = max(
+                statistics.median(longs) - statistics.median(shorts),
+                1e-9,
+            )
+            ratio = (d_l - d_s) / max(s_l - s_s, 1)
+        finally:
+            eng.stop()
+        return steps / dt, ratio
+
+    tps_k8, ratio_k8 = _phase(8)
+    tps_k1, ratio_k1 = _phase(1)
+    return {
+        "decode_tokens_per_sec_b32_k8": round(tps_k8, 1),
+        "decode_tokens_per_sec_b32_k1": round(tps_k1, 1),
+        "decode_window_speedup_k8": round(tps_k8 / max(tps_k1, 1e-9), 3),
+        "decode_dispatches_per_token": round(ratio_k8, 4),
+        "decode_dispatches_per_token_k1": round(ratio_k1, 4),
+    }
+
+
 def fleet_routing_bench(n_replicas=3, families=6, per_family=4,
                         prefix_len=256, tail=8, max_new=4,
                         model="bench-280m", seed=17):
@@ -1397,6 +1501,22 @@ def main() -> None:
                 extras[key] = slo[key]
         except Exception as e:
             extras["serving_slo_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # dispatch-amortization phase (multi-step decode PR): K=8 fused
+        # windows vs the K=1 loop at B=32, plus the chain-differenced
+        # dispatches-per-token ratio (1/K when windows engage)
+        try:
+            dw = decode_window_bench()
+            for key in (
+                "decode_tokens_per_sec_b32_k8",
+                "decode_tokens_per_sec_b32_k1",
+                "decode_window_speedup_k8",
+                "decode_dispatches_per_token",
+                "decode_dispatches_per_token_k1",
+            ):
+                extras[key] = dw[key]
+        except Exception as e:
+            extras["decode_window_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
         # fleet-routing phase (prefix-cache-aware router PR): p50 TTFT
         # through the summary-scoring router vs cache-blind round-robin

@@ -489,6 +489,97 @@ def _scn_registry_scrape(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_multistep(fz: SchedFuzzer):
+    """Staged-admission protocol of the multi-step decode loop
+    (batching._plan_admissions / _admit_pending / _fail_inflight).
+
+    The scheduler plans admissions WHILE a fused window is notionally
+    in flight (pop pending + alloc KV blocks, staged under the engine
+    lock), drains the staged list at the window boundary, and a
+    concurrent stop() may sweep the staged/pending lists at any
+    interleaving — the exact double-buffered bookkeeping the fused
+    loop added. Invariants under EVERY schedule: block refs balance
+    back to zero and each request reaches exactly one terminal state
+    (served xor failed) — a schedule that loses a staged plan leaks
+    pool refs, one that double-drains serves a request twice.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool
+
+    pool = BlockPool(32, 4)
+    lock = make_lock("schedfuzz.engine-multistep._lock")
+    pending: list[int] = []
+    staged: list[tuple[int, list[int]]] = []
+    served: list[int] = []
+    failed: list[int] = []
+    state = {"stopped": False}
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                # post-stop submits fail fast instead of queueing
+                # (ContinuousEngine.submit after stop())
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def scheduler() -> None:
+        for _ in range(10):
+            # overlap phase: the window is in flight; plan host-side.
+            # The stop check and the stage share ONE lock hold, so no
+            # plan can be staged after the stop sweep captured the list
+            with lock:
+                if state["stopped"]:
+                    return
+                if pending:
+                    staged.append((pending.pop(0), pool.alloc(2)))
+            # window boundary: drain the staged plans. Entries popped
+            # here are owned by this thread — a stop landing after the
+            # pop still sees them served, never swept twice
+            with lock:
+                if state["stopped"]:
+                    return
+                batch = staged[:]
+                staged.clear()
+            for rid, blocks in batch:
+                pool.unref(blocks)  # serve + retire, compressed
+                with lock:
+                    served.append(rid)
+
+    def stopper() -> None:
+        # a few pure yield points first so the seed decides where the
+        # stop lands relative to plan/drain/submit
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            swept = staged[:]
+            staged.clear()
+            leftover = pending[:]
+            pending.clear()
+        # unref outside the lock, like _fail_inflight (pool takes its
+        # own lock; engine->pool is the production order)
+        for rid, blocks in swept:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("sched", scheduler)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not staged and not pending, (staged, pending)
+        assert sorted(served + failed) == list(range(6)), (served, failed)
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -498,6 +589,7 @@ SCENARIOS = [
     Scenario("flight-churn", _scn_flight_churn),
     Scenario("fault-burst", _scn_fault_burst),
     Scenario("registry-scrape", _scn_registry_scrape),
+    Scenario("engine-multistep", _scn_engine_multistep),
 ]
 
 

@@ -11,10 +11,15 @@ equivalent, built from static shapes:
   ``SlotState`` pytree that never changes shape; every
   allocation/refcount/free decision is host-side (kv_blocks.py),
   between device steps.
-- ``decode_step`` advances EVERY active slot one token in ONE jitted
-  call — compiled exactly once. The step's K/V land via one batched
-  scatter through the block tables; attention reads the pool through
-  the same tables (flash_attention.decode_attention_blocks_auto).
+- ``stepper.decode_window`` advances EVERY active slot K tokens in ONE
+  jitted call — compiled once per horizon bucket (K ∈ {1, 2, 4, 8}),
+  so the per-dispatch floor is paid once per K tokens. Each fused step's
+  K/V land via one batched scatter through the block tables; attention
+  reads the pool through the same tables
+  (flash_attention.decode_attention_blocks_auto). The scheduler picks K
+  per pass (``_pick_horizon``) and overlaps its own bookkeeping with
+  the in-flight window (``_plan_admissions``), syncing tokens only at
+  the window boundary.
 - New requests **prefill into a free slot** (compiled once per SUFFIX
   bucket) while other slots keep decoding. A host-side radix cache
   (kv_blocks.RadixCache, SGLang's RadixAttention idea) matches the
@@ -53,6 +58,13 @@ from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 from kubeinfer_tpu.observability.slo import SLOMonitor, SLOObjective
 from kubeinfer_tpu.observability.stepprof import StepProfiler
+from kubeinfer_tpu.inference.stepper import (
+    SlotState,
+    WINDOW_BUCKETS,
+    decode_window,
+    init_slot_state,
+    sample_rows,
+)
 
 log = logging.getLogger(__name__)
 
@@ -71,174 +83,11 @@ _MAX_TOKEN_EVENTS = 128
 DEFAULT_BLOCK_SIZE = 128
 
 # --- device state ----------------------------------------------------------
-
-
-@dataclass
-class SlotState:
-    """All device-resident decode state (fixed shapes).
-
-    The KV pool is SHARED across slots: row b's logical cache position
-    p lives in ``caches_k[l][tables[b, p // bs], p % bs]``. Block 0 is
-    the reserved null block (kv_blocks.NULL_BLOCK): dead table entries
-    and retired rows point there, so every gather/scatter index is
-    always valid without data-dependent control flow under jit."""
-
-    caches_k: list[jax.Array]  # L x [num_blocks, block_size, n_kv, D]
-    caches_v: list[jax.Array]
-    tables: jax.Array  # i32[B, max_blocks] pool indices, seq order
-    last_token: jax.Array  # i32[B]
-    offset: jax.Array  # i32[B] next cache position (= current length)
-    active: jax.Array  # bool[B]
-    temperature: jax.Array  # f32[B]; <=0 = greedy
-    top_k: jax.Array  # i32[B]; <1 = disabled
-    top_p: jax.Array  # f32[B]; >=1 = disabled
-    rep_penalty: jax.Array  # f32[B]; 1.0 = disabled
-    seen: jax.Array  # bool[B, V] ids in prompt or generated so far
-    rng: jax.Array  # u32[B, 2] per-slot PRNG key data
-
-
-jax.tree_util.register_dataclass(
-    SlotState,
-    data_fields=["caches_k", "caches_v", "tables", "last_token", "offset",
-                 "active", "temperature", "top_k", "top_p", "rep_penalty",
-                 "seen", "rng"],
-    meta_fields=[],
-)
-
-
-def _init_state(cfg: ModelConfig, n_slots: int, cache_len: int,
-                dtype, num_blocks: int, block_size: int) -> SlotState:
-    shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
-    return SlotState(
-        caches_k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
-        caches_v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
-        tables=jnp.zeros((n_slots, cache_len // block_size), jnp.int32),
-        last_token=jnp.zeros((n_slots,), jnp.int32),
-        offset=jnp.zeros((n_slots,), jnp.int32),
-        active=jnp.zeros((n_slots,), bool),
-        temperature=jnp.zeros((n_slots,), jnp.float32),
-        top_k=jnp.zeros((n_slots,), jnp.int32),
-        top_p=jnp.ones((n_slots,), jnp.float32),
-        rep_penalty=jnp.ones((n_slots,), jnp.float32),
-        # [n_slots, V] bool lives for the engine's lifetime and the
-        # keep-mask select threads through every decode step even when
-        # no request sets repetition_penalty (advisor r2: megabytes at
-        # production vocab x slot counts, not gigabytes — acceptable; if
-        # slot counts grow, allocate lazily / gate the select on
-        # any-penalty-enabled)
-        seen=jnp.zeros((n_slots, cfg.vocab_size), bool),
-        rng=jnp.zeros((n_slots, 2), jnp.uint32),
-    )
-
-
-def _sample_rows(
-    logits: jax.Array,  # f32[B, V]
-    temperature: jax.Array,  # f32[B]
-    top_k: jax.Array,  # i32[B]
-    top_p: jax.Array,  # f32[B]
-    rep_penalty: jax.Array,  # f32[B]
-    seen: jax.Array,  # bool[B, V]
-    rng: jax.Array,  # u32[B, 2]
-    counter: jax.Array,  # i32[B] — folded in so each step draws fresh noise
-) -> jax.Array:
-    from kubeinfer_tpu.inference.engine import (
-        apply_repetition_penalty,
-        filter_logits,
-        gumbel_pick,
-    )
-
-    logits = apply_repetition_penalty(logits, seen, rep_penalty)
-
-    # filter at BATCH level so filter_logits' lax.cond fast-paths engage
-    # (inside the vmap a batched predicate would lower to select and pay
-    # the full-vocab nucleus sort on every step even with filters off);
-    # only the per-row gumbel pick is vmapped
-    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-    filtered = filter_logits(scaled, top_k, top_p)
-
-    def pick_one(row_logits, row_filtered, key_data, ctr, temp):
-        key = jax.random.fold_in(
-            jax.random.wrap_key_data(key_data, impl="threefry2x32"), ctr
-        )
-        return gumbel_pick(row_logits, row_filtered, key, temp)
-
-    return jax.vmap(pick_one)(logits, filtered, rng, counter, temperature)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
-)
-def _decode_step(
-    params: Params, state: SlotState, cfg: ModelConfig
-) -> tuple[SlotState, jax.Array]:
-    """One token for every active slot (greedy, or per-slot temperature\n    sampling keyed by the slot PRNG + offset); returns (state, tokens).
-
-    Inactive slots still flow through the math (static shapes) but their
-    cache/offset/token state is preserved unchanged.
-    """
-    B = state.last_token.shape[0]
-    block_size = state.caches_k[0].shape[1]
-    S = state.tables.shape[1] * block_size  # logical per-row cache width
-    mask = (jnp.arange(S)[None, None, :] < (state.offset + 1)[:, None, None])
-    mask = jnp.broadcast_to(mask, (B, 1, S))
-
-    # the step's K/V scatter through the block tables (decoder_layer's
-    # paged branch); attention reads the pool through the same tables —
-    # the block-table Pallas kernel on TPU DMAs only each row's live
-    # blocks (and shared prefix blocks once per consecutive reuse),
-    # gather + dense fallback elsewhere
-    from kubeinfer_tpu.inference.flash_attention import (
-        decode_attention_blocks_auto,
-    )
-
-    logits, caches = forward(
-        params,
-        state.last_token[:, None],
-        cfg,
-        positions=state.offset[:, None],
-        attn_mask=mask,
-        kv_caches=list(zip(state.caches_k, state.caches_v)),
-        cache_offset=state.offset,
-        block_tables=state.tables,
-        attn_fn=lambda q, k, v, m: decode_attention_blocks_auto(
-            q, k, v, state.tables, state.offset + 1, m
-        ),
-    )
-    new_k = [c[0] for c in caches]
-    new_v = [c[1] for c in caches]
-    # counter offset+1: admit folds prompt_len (== first decode offset),
-    # so folding the bare offset here would reuse the admit-time gumbel
-    # draw and systematically double the first sampled token
-    nxt = _sample_rows(
-        logits[:, 0], state.temperature, state.top_k, state.top_p,
-        state.rep_penalty, state.seen, state.rng, state.offset + 1,
-    )
-
-    keep = state.active
-    # dataclasses.replace carries unchanged fields automatically — a
-    # full-constructor copy here silently reset any SlotState field
-    # added later (this diff had to hand-thread top_k/top_p through two
-    # such copies before the conversion)
-    new_state = dataclasses.replace(
-        state,
-        # no keep-masking on the pool: a retired slot's table row is
-        # all-null (see _maybe_retire), so an inactive row's scatter
-        # lands in the sacrificial block 0 and the pool is taken as-is
-        # (a per-row where over a SHARED pool would be wrong anyway —
-        # rows no longer own disjoint stripes)
-        caches_k=new_k,
-        caches_v=new_v,
-        last_token=jnp.where(keep, nxt, state.last_token),
-        offset=jnp.where(keep, state.offset + 1, state.offset),
-        # record_seen self-gates on any-penalty-enabled; masking by
-        # keep afterwards preserves inactive slots
-        seen=jnp.where(
-            keep[:, None],
-            record_seen(state.seen, nxt, state.rep_penalty),
-            state.seen,
-        ),
-    )
-    return new_state, jnp.where(keep, nxt, -1)
+# SlotState and the fused decode window live in stepper.py (ROADMAP
+# item 3's unification: one stepper serves the per-request engine, the
+# sequence-parallel engine, and this batcher); the admit/prefill-chunk
+# dispatches below stay here — they are paged-pool plumbing the other
+# engines never touch.
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
@@ -301,7 +150,7 @@ def _admit_slot(
     )
 
     last = jnp.clip(suffix_len - 1, 0, T - 1)
-    first = _sample_rows(
+    first = sample_rows(
         logits[:, last], temperature[None], top_k[None], top_p[None],
         rep_penalty[None], seen_row, key_data[None], prompt_len[None],
     )[0]
@@ -472,6 +321,12 @@ class _Request:
     t_parked: float = 0.0
     preemptions: int = 0
     tokens_at_admit: int = 0
+    # True once any token_times entry was interpolated from a fused
+    # window bracket rather than observed per step — the decode span
+    # carries it as ``kubeinfer.interpolated`` so trace readers don't
+    # mistake the evenly spaced events for per-step measurements
+    # (docs/OBSERVABILITY.md)
+    interpolated: bool = False
 
     @property
     def pending_since(self) -> float:
@@ -528,7 +383,8 @@ class ContinuousEngine:
                  speculative=None, block_size: int | None = None,
                  num_blocks: int | None = None,
                  prefill_chunk_blocks: int = 0,
-                 preemption: PreemptionPolicy | None = None) -> None:
+                 preemption: PreemptionPolicy | None = None,
+                 max_window: int = 8) -> None:
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -572,6 +428,23 @@ class ContinuousEngine:
                 f"{prefill_chunk_blocks}"
             )
         self.chunk_tokens = prefill_chunk_blocks * self.block_size
+        # fused decode windows: horizons are drawn from the static
+        # bucket set clipped to max_window (one compiled shape per
+        # bucket — stepper.WINDOW_BUCKETS). max_window=1 restores the
+        # one-dispatch-per-token loop exactly.
+        if max_window < 1:
+            raise ValueError(f"max_window must be >= 1, got {max_window}")
+        self.max_window = max_window
+        self._window_buckets = tuple(
+            b for b in WINDOW_BUCKETS if b <= max_window
+        )
+        self.windows_total = 0  # telemetry: fused decode dispatches
+        # admissions PLANNED while a decode window is in flight
+        # (host-side radix match + block alloc only — no device work):
+        # (req, slot, kv_plan, effective tokens), admitted at the next
+        # window boundary by _admit_pending. Mutated under _lock; swept
+        # by _fail_inflight like every other handoff field.
+        self._staged: list[tuple[_Request, int, tuple, list[int]]] = []
         # SLO-aware preemption: the engine owns a PRIVATE monitor (the
         # server's SLOMonitor aggregates every route; feeding the
         # scheduler from it would double-count queue_wait and couple
@@ -635,7 +508,7 @@ class ContinuousEngine:
         # preemption interleaves parked readmits with fresh arrivals,
         # so two unplaced requests can be in hand at once.
         self._holdover: "collections.deque[_Request]" = collections.deque()
-        self._state = _init_state(
+        self._state = init_slot_state(
             cfg, n_slots, cache_len, params["norm"].dtype,
             num_blocks, self.block_size,
         )
@@ -753,6 +626,8 @@ class ContinuousEngine:
             "chunks": self.chunks_total,
             "chunk_queue": len(self._prefills),
             "parked": len(self._parked),
+            # fused decode dispatches (each covers 1..max_window steps)
+            "windows": self.windows_total,
         }
 
     def _note(self, kind: str, **detail) -> None:
@@ -879,6 +754,11 @@ class ContinuousEngine:
             held = list(self._holdover)
             self._holdover.clear()
             parked, self._parked = self._parked, []
+            # staged admissions hold pool references but no slot yet:
+            # release the planned blocks and fail the requests (they
+            # were popped from the pending order, so nothing else will
+            # serve them)
+            staged, self._staged = self._staged, []
             # chunked-prefill tasks' requests are already published in
             # _slot_req (the slot is reserved at plan time), so the
             # slot sweep below releases them; only the task list needs
@@ -899,6 +779,12 @@ class ContinuousEngine:
             # parked requests carry partial output: fail, never return
             # a truncated token list as a normal completion
             req.failed = "engine stopped mid-generation"
+            req.done.set()
+            failed += 1
+        for req, _slot, kv_plan, _tokens in staged:
+            table_row, _own, _reuse, total = kv_plan
+            self._pool.unref([int(b) for b in table_row[:total]])
+            req.failed = "engine stopped before the request was served"
             req.done.set()
             failed += 1
         if group is not None:
@@ -1103,7 +989,7 @@ class ContinuousEngine:
         the lock). For a resumed request the suffix counter equals the
         uninterrupted run's decode counter at the same position
         (_admit_slot folds prompt_len == original prompt + generated;
-        _decode_step folds offset + 1), so preempted and uninterrupted
+        stepper.decode_body folds offset + 1), so preempted and uninterrupted
         runs draw identical sampling noise — the token-identity
         invariant the preemption tests pin."""
         req, slot, tokens = task.req, task.slot, task.tokens
@@ -1120,7 +1006,7 @@ class ContinuousEngine:
         # pre-preemption tokens too
         seen_row = np.zeros((1, self.cfg.vocab_size), bool)
         seen_row[0, np.asarray(tokens, np.int64)] = True
-        # explicit impl: _sample_rows wraps with threefry2x32 and
+        # explicit impl: stepper.sample_rows wraps with threefry2x32 and
         # SlotState.rng is u32[B, 2]; deriving from the default-impl
         # PRNGKey would break under jax_default_prng_impl=rbg (u32[4])
         key_data = jax.random.key_data(
@@ -1222,6 +1108,13 @@ class ContinuousEngine:
                 start=req.t_first or req.t_done, slot=slot,
                 tokens=len(req.out_tokens),
                 cancelled=req.cancelled.is_set(),
+                # stamped whenever any token event below carries an
+                # interpolated timestamp (fused windows observe one
+                # bracket per K tokens, not one clock read per token) —
+                # trace readers must not treat the events as per-step
+                # measurements (docs/OBSERVABILITY.md, TPOT row)
+                **({"kubeinfer.interpolated": True}
+                   if req.interpolated else {}),
             )
             for i, ts in enumerate(req.token_times[:_MAX_TOKEN_EVENTS]):
                 sp.event("token", ts=ts, i=i)
@@ -1564,13 +1457,114 @@ class ContinuousEngine:
     def _admit_pending(self) -> None:
         """Place pending requests (parked readmits and arrivals, oldest
         first) until something has to wait — all slots busy, or pool
-        backpressure."""
+        backpressure. Plans staged by ``_plan_admissions`` while the
+        last decode window was in flight go first: their radix/alloc
+        work is already done, and they were popped from the pending
+        order ahead of whatever is still queued."""
+        with self._lock:
+            staged, self._staged = self._staged, []
+        for req, slot, kv_plan, tokens in staged:
+            with self._lock:
+                if req.cancelled.is_set() or \
+                        self._slot_req[slot] is not None:
+                    # release the plan's block holds; a cancelled
+                    # request retires unserved, an occupied slot (only
+                    # reachable through a future scheduler change —
+                    # this thread is the sole admitter) sends the
+                    # request back to the head of the line
+                    table_row, _own, _reuse, total = kv_plan
+                    self._pool.unref(
+                        [int(b) for b in table_row[:total]]
+                    )
+                    if req.cancelled.is_set():
+                        req.t_done = tracing.now()
+                        req.done.set()
+                    else:
+                        self._holdover.appendleft(req)
+                    continue
+                # lint: allow[blocking-under-lock] same ceiling as _place: the admit-path jit compile (cold bucket ~tens of seconds) runs under _lock so stop() sees a consistent slot/pool state
+                self._admit(slot, req, kv_plan, tokens)
         while True:
             req = self._pop_pending()
             if req is None:
                 return
             if not self._place(req):
                 return
+
+    def _plan_admissions(self) -> None:
+        """The host half of admission, overlapped with the in-flight
+        decode window: pop pending requests (same longest-pending-first
+        order as ``_admit_pending``) and run radix match + reuse clamp
+        + block alloc, staging ``(req, slot, plan, tokens)`` for the
+        next window boundary. No device dispatch and no readback
+        happens here, so the whole pass runs while the device chews
+        the window; the jit admits (which may compile for tens of
+        seconds) stay at the boundary. Spec-eligible heads are pushed
+        back for ``_place`` — forming a draft group dispatches device
+        work immediately, which must not race the window's donated
+        state."""
+        while True:
+            with self._lock:
+                taken = {s for _r, s, _p, _t in self._staged}
+                free = [
+                    s for s in range(self.n_slots)
+                    if self._slot_req[s] is None and s not in taken
+                ]
+            if not free:
+                return
+            req = self._pop_pending()
+            if req is None:
+                return
+            if req.cancelled.is_set():
+                req.t_done = tracing.now()
+                req.done.set()
+                continue
+            resumed = bool(req.out_tokens)
+            with self._lock:
+                group_free = self._spec_group is None
+            if (
+                self.speculative is not None
+                and group_free
+                and not resumed
+                and req.rep_penalty == 1.0
+                and self.speculative.fits(len(req.prompt), req.max_new)
+            ):
+                with self._lock:
+                    # head of the line again: _place routes it at the
+                    # boundary (it was the oldest pending request)
+                    self._holdover.appendleft(req)
+                return
+            with self._lock:
+                tokens = req.prompt + req.out_tokens
+                kv_plan = self._plan_kv(
+                    tokens, req.max_new - len(req.out_tokens)
+                )
+                if kv_plan is None:
+                    self._holdover.appendleft(req)
+                    return
+                self._staged.append((req, free[0], kv_plan, tokens))
+
+    def _pick_horizon(self, budgets: list[int], host_work: bool) -> int:
+        """Decode-window horizon for this pass, from the static bucket
+        set (one compiled shape each). K collapses to 1 whenever the
+        host has competing work — pending admissions, chunked prefills,
+        a live draft group, a cancelled row — so fused windows never
+        starve admission, prefill interleave, or retirement; otherwise
+        K is the largest bucket no row can overshoot (min remaining
+        budget), so ``max_new`` is never crossed mid-window, every
+        retirement lands exactly at a window boundary, and every write
+        stays inside the row's allocated block span. SLO burn needs no
+        separate clamp: preemption pressure requires a waiter, and any
+        waiter already forces K=1 (the preemption check itself runs
+        between windows, so parks land at boundaries too)."""
+        if host_work or not budgets:
+            return 1
+        lim = min(min(budgets), self.max_window)
+        k = 1
+        for b in self._window_buckets:
+            if b <= lim:
+                k = b
+        return k
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -1605,39 +1599,85 @@ class ContinuousEngine:
                 # (active=False, null tables); they are padding in the
                 # decode dispatch, not live rows
                 prefilling = {t.slot for t in self._prefills}
-                decode_rows = sum(
-                    1 for s, r in enumerate(self._slot_req)
+                budgets = [
+                    r.max_new - len(r.out_tokens)
+                    for s, r in enumerate(self._slot_req)
                     if r is not None and s not in prefilling
+                ]
+                decode_rows = len(budgets)
+                host_work = (
+                    bool(self._holdover) or bool(self._parked)
+                    or bool(self._prefills)
+                    or self._spec_group is not None
+                    or any(
+                        r is not None and r.cancelled.is_set()
+                        for r in self._slot_req
+                    )
                 )
+            # arrival-queue peek outside the lock (qsize takes the
+            # queue's own lock); a racing submit only costs one pass
+            # of K=1 or one window of delayed admission — never
+            # correctness
+            host_work = host_work or not self._queue.empty()
             if decode_rows:
-                # device step outside the lock (it can block on a
+                k = self._pick_horizon(budgets, host_work)
+                # device window outside the lock (it can block on a
                 # compile; stop() must still be able to fail the slots)
                 step_t0 = tracing.now()
                 # lint: allow[lock-discipline] scheduler thread is the only _state writer; see comment above
-                self._state, tokens = _decode_step(
-                    self.params, self._state, self.cfg
+                self._state, tokens = decode_window(
+                    self.params, self._state, self.cfg, k
                 )
-                # lint: allow[host-sync] per-step decode boundary: tokens feed the Python result queues
+                # the dispatch returns a future immediately (JAX async
+                # dispatch): the admission planning below is the host
+                # work overlapped with the device window, and the
+                # readback after it is the one synchronization point
+                self._plan_admissions()
+                # lint: allow[host-sync] window boundary: the [n_slots, k] token matrix feeds the Python result queues
                 toks = np.asarray(tokens)
-                # one clock read per device step, outside the lock: all
-                # tokens of a step share its arrival time
+                # one clock read per WINDOW, outside the lock: token
+                # times inside the bracket are interpolated below
+                # (docs/OBSERVABILITY.md — traces carry
+                # kubeinfer.interpolated so nobody reads them as
+                # per-step measurements)
                 step_t = tracing.now()
-                # decode dispatch is always the full n_slots-wide batch
-                # (static shapes): inactive rows are pure padding
-                self.profiler.record(
-                    "decode", bucket=self.n_slots, live_rows=decode_rows,
-                    live_tokens=decode_rows,
-                    padded_tokens=self.n_slots - decode_rows,
-                    start=step_t0, end=step_t,
-                )
-                self._steps_since_preempt += 1
+                self.windows_total += 1
+                self._steps_since_preempt += k
+                accepted = 0
                 with self._lock:
-                    for slot in range(self.n_slots):
-                        req = self._slot_req[slot]
-                        if req is not None and toks[slot] >= 0:
-                            req.out_tokens.append(int(toks[slot]))
-                            req.token_times.append(step_t)
+                    for j in range(k):
+                        t_j = step_t0 + (j + 1) * (step_t - step_t0) / k
+                        for slot in range(self.n_slots):
+                            # host-side EOS masking: _maybe_retire
+                            # clears _slot_req at the EOS/budget token,
+                            # so a retired row's tail tokens in the
+                            # same window fall through the req-is-None
+                            # check — the device kept scattering junk
+                            # into the row's own refcounted blocks,
+                            # which nobody reads (same null-block
+                            # discipline as retirement, and always
+                            # inside the row's allocated span by the
+                            # horizon clamp)
+                            req = self._slot_req[slot]
+                            if req is None or toks[slot, j] < 0:
+                                continue
+                            req.out_tokens.append(int(toks[slot, j]))
+                            req.token_times.append(t_j)
+                            if k > 1:
+                                req.interpolated = True
+                            accepted += 1
                             self._maybe_retire(slot)
+                # ONE record per fused dispatch: bucket=k is the
+                # compiled-shape knob (first-seen per window bucket ==
+                # one compile each), live_tokens counts only tokens
+                # that reached a request — inactive rows and masked
+                # post-EOS tails are padding of the n_slots x k window
+                self.profiler.record(
+                    "decode", bucket=k, live_rows=decode_rows,
+                    live_tokens=accepted,
+                    padded_tokens=self.n_slots * k - accepted,
+                    start=step_t0, end=step_t, steps=k,
+                )
             self._step_prefill()  # at most one chunk per pass
             self._step_spec_group()  # locked no-op when no group is live
         # epilogue: anything published after stop()'s sweep (admission

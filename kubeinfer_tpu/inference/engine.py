@@ -8,7 +8,9 @@ data-dependent Python control flow inside jit"):
 - the decode loop is ONE jitted ``lax.scan`` over ``max_new_tokens``
   steps writing into a fixed-capacity KV cache — no per-token dispatch,
   no dynamic shapes; finished sequences (EOS) keep stepping but their
-  outputs are masked (the standard static-shape idiom);
+  outputs are masked (the standard static-shape idiom). The loop itself
+  lives in stepper.py (shared with the sequence-parallel engine and the
+  continuous batcher's fused windows — ROADMAP item 3's unification);
 - sampling is greedy or temperature (gumbel trick) selected by a traced
   scalar, so one compilation serves both.
 
@@ -30,7 +32,6 @@ import numpy as np
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.flash_attention import (
     attention_auto,
-    decode_attention_auto,
     flash_attention_ragged,
     flash_available,
 )
@@ -342,85 +343,6 @@ def make_caches(cfg: ModelConfig, B: int, cache_len: int, dtype):
     ]
 
 
-def decode_scan(
-    params: Params,
-    cfg: ModelConfig,
-    caches,  # per-layer (k, v) with the prompt's KV already written
-    next_logits: jax.Array,  # f32[B, V] logits at each row's last prompt pos
-    prompt: jax.Array,  # i32[B, T_bucket] (repetition-penalty seed state)
-    prompt_len: jax.Array,  # i32[B]; rows may be length-ragged
-    max_new: int,
-    cache_len: int,
-    eos_id: jax.Array,
-    temperature: jax.Array,
-    top_k: jax.Array,
-    top_p: jax.Array,
-    rep_penalty: jax.Array,
-    rng_key: jax.Array,
-):
-    """The decode loop shared by every prefill strategy (chunked single-
-    device, sequence-parallel ring — sp_engine.py): sample from
-    ``next_logits``, then scan single-token steps against the caches.
-    Callers jit."""
-    B = prompt.shape[0]
-
-    def sample(logits, key, seen):
-        logits = apply_repetition_penalty(logits, seen, rep_penalty)
-        return gumbel_sample(logits, key, temperature, top_k, top_p)
-
-    seen = seen_from_prompt(prompt, prompt_len, cfg.vocab_size)
-    k0, krest = jax.random.split(rng_key)
-    first = sample(next_logits, k0, seen)
-    seen = record_seen(seen, first, rep_penalty)
-
-    def step(carry, key):
-        caches, tok, offset, done, seen = carry
-        step_mask = (jnp.arange(cache_len)[None, None, :] <= offset[:, None, None])
-        # per-row offsets: each row writes its token at its OWN cache
-        # position (batched scatter in decoder_layer) and attends to its
-        # own live prefix — one dispatch decodes a length-ragged batch.
-        # On TPU the decode kernel reads only each row's live KV tiles
-        # (lengths operand == the mask's live set, offset + 1); the mask
-        # remains the dense fallback operand.
-        logits, caches = forward(
-            params, tok[:, None], cfg,
-            positions=offset[:, None],
-            attn_mask=jnp.broadcast_to(step_mask, (B, 1, cache_len)),
-            kv_caches=caches,
-            cache_offset=offset,
-            attn_fn=lambda q, k, v, mask: decode_attention_auto(
-                q, k, v, offset + 1, mask
-            ),
-        )
-        nxt = sample(logits[:, 0], key, seen)
-        seen = record_seen(seen, nxt, rep_penalty)
-        newly_done = (nxt == eos_id) & (eos_id >= 0)
-        nxt = jnp.where(done, eos_id, nxt)
-        done = done | newly_done
-        return (caches, nxt, offset + 1, done, seen), nxt
-
-    done0 = (first == eos_id) & (eos_id >= 0)
-    if max_new > 1:
-        keys = jax.random.split(krest, max_new - 1)
-        (_, _, _, done, _), rest = jax.lax.scan(
-            step,
-            (caches, first, prompt_len, done0, seen),
-            keys,
-            length=max_new - 1,
-        )
-        toks = jnp.concatenate(
-            [first[:, None], rest.swapaxes(0, 1)], axis=1
-        )
-    else:
-        toks = first[:, None]
-    # generated length = tokens up to and including first EOS
-    is_eos = (toks == eos_id) & (eos_id >= 0)
-    first_eos = jnp.where(
-        is_eos.any(axis=1), is_eos.argmax(axis=1) + 1, max_new
-    )
-    return toks, first_eos.astype(jnp.int32)
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new", "cache_len", "prefill_chunk"),
@@ -440,6 +362,11 @@ def _generate_jit(
     rep_penalty: jax.Array,  # f32; 1.0 = disabled
     rng_key: jax.Array,
 ):
+    # stepper imports this module's sampling helpers at module level, so
+    # the decode loop comes back lazily (trace time only — inside the
+    # jit, like batching's kernel imports)
+    from kubeinfer_tpu.inference.stepper import decode_scan
+
     B, T = prompt.shape
     caches = make_caches(cfg, B, cache_len, params["norm"].dtype)
 

@@ -35,9 +35,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import (
     GenerationResult,
-    decode_scan,
     prepare_prompts,
 )
+from kubeinfer_tpu.inference.stepper import decode_scan
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.inference.ring_attention import ring_attention
 

@@ -1,0 +1,367 @@
+"""The one decode stepper: every serving path's token loop lives here.
+
+Three decode loops used to coexist — ``Engine.generate``'s dense-cache
+``lax.scan``, ``SPEngine``'s copy of the same call, and
+``ContinuousEngine``'s per-token ``_decode_step`` dispatch — divergent
+in everything but intent (ROADMAP item 3). This module collapses them:
+
+- :func:`step_forward` is the single-token forward both loops share —
+  the dense-cache route (per-row ``[B, S, ...]`` caches) and the paged
+  route (shared block pool + ``i32[B, max_blocks]`` tables) differ only
+  in which attention reader the trace binds, so the jnp twin and the
+  block-table Pallas kernel are reached per-step exactly as before.
+- :func:`decode_scan` is the fused fixed-horizon loop the per-request
+  and sequence-parallel engines jit (prefill hands it dense caches).
+- :func:`decode_window` is the continuous batcher's fused K-step window:
+  ONE jitted dispatch runs K steps as a ``lax.scan`` over the donated
+  :class:`SlotState` and returns the ``[n_slots, K]`` token matrix.
+  K is static — the scheduler picks it from a small bucket set, one
+  compiled shape each — so the per-dispatch floor (BENCH_r02–r04:
+  ~70–90 ms on the relay vs ~1–4 ms of solve) is paid once per K
+  tokens instead of once per token.
+
+Bit-identity across horizons is by construction, not luck: sampling
+keys are position-folded (``sample_rows`` folds ``offset + 1``; admit
+folds ``prompt_len``), so a fused window draws exactly the noise the
+same steps would draw dispatched one at a time — the parity tests pin
+K∈{1,2,4,8} against single-step streams, greedy and sampled.
+
+Reference divergence: the reference operator never owns a decode loop —
+it delegates stepping wholesale to the vLLM subprocess
+(internal/agent/vllm.go:93-112) and multi-step scheduling is vLLM's
+internal affair. Our engine owns its schedule, so the window, its
+horizon policy, and the host/device overlap are built natively here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.engine import (
+    apply_repetition_penalty,
+    filter_logits,
+    gumbel_pick,
+    gumbel_sample,
+    record_seen,
+    seen_from_prompt,
+)
+from kubeinfer_tpu.inference.flash_attention import (
+    decode_attention_auto,
+    decode_attention_blocks_auto,
+)
+from kubeinfer_tpu.inference.model import Params, forward
+
+__all__ = [
+    "SlotState", "init_slot_state", "sample_rows", "step_forward",
+    "decode_body", "decode_window", "decode_scan", "WINDOW_BUCKETS",
+]
+
+# Static decode-window horizons: one compiled shape each, so the
+# scheduler can retune K per pass without ever paying a fresh compile.
+# Powers of two keep the set tiny while spanning the useful range — by
+# K=8 the dispatch floor is already amortized below the solve time.
+WINDOW_BUCKETS = (1, 2, 4, 8)
+
+
+# --- device state ----------------------------------------------------------
+
+
+@dataclass
+class SlotState:
+    """All device-resident decode state (fixed shapes).
+
+    The KV pool is SHARED across slots: row b's logical cache position
+    p lives in ``caches_k[l][tables[b, p // bs], p % bs]``. Block 0 is
+    the reserved null block (kv_blocks.NULL_BLOCK): dead table entries
+    and retired rows point there, so every gather/scatter index is
+    always valid without data-dependent control flow under jit."""
+
+    caches_k: list[jax.Array]  # L x [num_blocks, block_size, n_kv, D]
+    caches_v: list[jax.Array]
+    tables: jax.Array  # i32[B, max_blocks] pool indices, seq order
+    last_token: jax.Array  # i32[B]
+    offset: jax.Array  # i32[B] next cache position (= current length)
+    active: jax.Array  # bool[B]
+    temperature: jax.Array  # f32[B]; <=0 = greedy
+    top_k: jax.Array  # i32[B]; <1 = disabled
+    top_p: jax.Array  # f32[B]; >=1 = disabled
+    rep_penalty: jax.Array  # f32[B]; 1.0 = disabled
+    seen: jax.Array  # bool[B, V] ids in prompt or generated so far
+    rng: jax.Array  # u32[B, 2] per-slot PRNG key data
+
+
+jax.tree_util.register_dataclass(
+    SlotState,
+    data_fields=["caches_k", "caches_v", "tables", "last_token", "offset",
+                 "active", "temperature", "top_k", "top_p", "rep_penalty",
+                 "seen", "rng"],
+    meta_fields=[],
+)
+
+
+def init_slot_state(cfg: ModelConfig, n_slots: int, cache_len: int,
+                    dtype, num_blocks: int, block_size: int) -> SlotState:
+    shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
+    return SlotState(
+        caches_k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
+        caches_v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
+        tables=jnp.zeros((n_slots, cache_len // block_size), jnp.int32),
+        last_token=jnp.zeros((n_slots,), jnp.int32),
+        offset=jnp.zeros((n_slots,), jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+        temperature=jnp.zeros((n_slots,), jnp.float32),
+        top_k=jnp.zeros((n_slots,), jnp.int32),
+        top_p=jnp.ones((n_slots,), jnp.float32),
+        rep_penalty=jnp.ones((n_slots,), jnp.float32),
+        # [n_slots, V] bool lives for the engine's lifetime and the
+        # keep-mask select threads through every decode step even when
+        # no request sets repetition_penalty (advisor r2: megabytes at
+        # production vocab x slot counts, not gigabytes — acceptable; if
+        # slot counts grow, allocate lazily / gate the select on
+        # any-penalty-enabled)
+        seen=jnp.zeros((n_slots, cfg.vocab_size), bool),
+        rng=jnp.zeros((n_slots, 2), jnp.uint32),
+    )
+
+
+def sample_rows(
+    logits: jax.Array,  # f32[B, V]
+    temperature: jax.Array,  # f32[B]
+    top_k: jax.Array,  # i32[B]
+    top_p: jax.Array,  # f32[B]
+    rep_penalty: jax.Array,  # f32[B]
+    seen: jax.Array,  # bool[B, V]
+    rng: jax.Array,  # u32[B, 2]
+    counter: jax.Array,  # i32[B] — folded in so each step draws fresh noise
+) -> jax.Array:
+    logits = apply_repetition_penalty(logits, seen, rep_penalty)
+
+    # filter at BATCH level so filter_logits' lax.cond fast-paths engage
+    # (inside the vmap a batched predicate would lower to select and pay
+    # the full-vocab nucleus sort on every step even with filters off);
+    # only the per-row gumbel pick is vmapped
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = filter_logits(scaled, top_k, top_p)
+
+    def pick_one(row_logits, row_filtered, key_data, ctr, temp):
+        key = jax.random.fold_in(
+            jax.random.wrap_key_data(key_data, impl="threefry2x32"), ctr
+        )
+        return gumbel_pick(row_logits, row_filtered, key, temp)
+
+    return jax.vmap(pick_one)(logits, filtered, rng, counter, temperature)
+
+
+# --- the shared single-token forward ---------------------------------------
+
+
+def step_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tok: jax.Array,  # i32[B] each row's last token
+    offset: jax.Array,  # i32[B] each row's next cache position
+    kv_caches,  # per-layer (k, v): dense [B, S, ...] or paged pool
+    cache_len: int,  # logical per-row cache width S
+    block_tables: jax.Array | None = None,  # i32[B, max_blocks] = paged
+):
+    """One decode token's forward pass for a length-ragged batch;
+    returns (logits f32[B, V], updated kv_caches).
+
+    The dense and paged routes share everything but the attention
+    reader: both scatter the step's K/V at each row's own offset
+    (decoder_layer picks the table-indirect scatter when
+    ``block_tables`` is given) and attend to positions ``< offset + 1``.
+    On TPU the decode kernels DMA only each row's live tiles (the
+    lengths operand == the mask's live set); the bool mask remains the
+    dense fallback operand."""
+    B = tok.shape[0]
+    mask = (jnp.arange(cache_len)[None, None, :]
+            < (offset + 1)[:, None, None])
+    mask = jnp.broadcast_to(mask, (B, 1, cache_len))
+    if block_tables is None:
+        def attn_fn(q, k, v, m):
+            return decode_attention_auto(q, k, v, offset + 1, m)
+    else:
+        def attn_fn(q, k, v, m):
+            return decode_attention_blocks_auto(
+                q, k, v, block_tables, offset + 1, m
+            )
+    logits, kv_caches = forward(
+        params, tok[:, None], cfg,
+        positions=offset[:, None],
+        attn_mask=mask,
+        kv_caches=kv_caches,
+        cache_offset=offset,
+        block_tables=block_tables,
+        attn_fn=attn_fn,
+    )
+    return logits[:, 0], kv_caches
+
+
+# --- the continuous batcher's fused window ---------------------------------
+
+
+def decode_body(
+    params: Params, state: SlotState, cfg: ModelConfig
+) -> tuple[SlotState, jax.Array]:
+    """One token for every active slot (greedy, or per-slot temperature
+    sampling keyed by the slot PRNG + offset); returns (state, tokens).
+
+    Inactive slots still flow through the math (static shapes) but their
+    cache/offset/token state is preserved unchanged. This is the scan
+    body of :func:`decode_window` — kept un-jitted so the window's K
+    steps trace into one program."""
+    block_size = state.caches_k[0].shape[1]
+    S = state.tables.shape[1] * block_size  # logical per-row cache width
+    logits, caches = step_forward(
+        params, cfg, state.last_token, state.offset,
+        list(zip(state.caches_k, state.caches_v)), S,
+        block_tables=state.tables,
+    )
+    new_k = [c[0] for c in caches]
+    new_v = [c[1] for c in caches]
+    # counter offset+1: admit folds prompt_len (== first decode offset),
+    # so folding the bare offset here would reuse the admit-time gumbel
+    # draw and systematically double the first sampled token
+    nxt = sample_rows(
+        logits, state.temperature, state.top_k, state.top_p,
+        state.rep_penalty, state.seen, state.rng, state.offset + 1,
+    )
+
+    keep = state.active
+    # dataclasses.replace carries unchanged fields automatically — a
+    # full-constructor copy here silently reset any SlotState field
+    # added later (this diff had to hand-thread top_k/top_p through two
+    # such copies before the conversion)
+    new_state = dataclasses.replace(
+        state,
+        # no keep-masking on the pool: a retired slot's table row is
+        # all-null (see batching._maybe_retire), so an inactive row's
+        # scatter lands in the sacrificial block 0 and the pool is
+        # taken as-is (a per-row where over a SHARED pool would be
+        # wrong anyway — rows no longer own disjoint stripes)
+        caches_k=new_k,
+        caches_v=new_v,
+        last_token=jnp.where(keep, nxt, state.last_token),
+        offset=jnp.where(keep, state.offset + 1, state.offset),
+        # record_seen self-gates on any-penalty-enabled; masking by
+        # keep afterwards preserves inactive slots
+        seen=jnp.where(
+            keep[:, None],
+            record_seen(state.seen, nxt, state.rep_penalty),
+            state.seen,
+        ),
+    )
+    return new_state, jnp.where(keep, nxt, -1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "k"), donate_argnums=(1,)
+)
+def decode_window(
+    params: Params, state: SlotState, cfg: ModelConfig, k: int
+) -> tuple[SlotState, jax.Array]:
+    """K fused decode steps in ONE dispatch; returns (state, i32[B, K]).
+
+    The scan threads the donated SlotState through K copies of
+    :func:`decode_body`, so each step's sampling sees exactly the state
+    a lone dispatch would have seen — token streams are bit-identical
+    to K single-step dispatches (the keys are position-folded, not
+    stream-split). ``active`` never changes mid-window (retirement is
+    host work): a row whose EOS lands mid-window keeps stepping and
+    keeps scattering into its own refcounted blocks — positions nobody
+    will ever read, since the host masks the tail tokens on readback
+    and the horizon clamp keeps every write inside the row's allocated
+    block span. -1 marks inactive rows' tokens, exactly as at K=1."""
+
+    def step(st, _):
+        return decode_body(params, st, cfg)
+
+    state, toks = jax.lax.scan(step, state, None, length=k)
+    # scan stacks on the leading (time) axis; callers want [slot, step]
+    return state, jnp.swapaxes(toks, 0, 1)
+
+
+# --- the per-request / sequence-parallel fused loop ------------------------
+
+
+def decode_scan(
+    params: Params,
+    cfg: ModelConfig,
+    caches,  # per-layer (k, v) with the prompt's KV already written
+    next_logits: jax.Array,  # f32[B, V] logits at each row's last prompt pos
+    prompt: jax.Array,  # i32[B, T_bucket] (repetition-penalty seed state)
+    prompt_len: jax.Array,  # i32[B]; rows may be length-ragged
+    max_new: int,
+    cache_len: int,
+    eos_id: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    rep_penalty: jax.Array,
+    rng_key: jax.Array,
+):
+    """The decode loop shared by every prefill strategy (chunked single-
+    device, sequence-parallel ring — sp_engine.py): sample from
+    ``next_logits``, then scan single-token steps against the caches.
+    Callers jit.
+
+    Key-schedule note: this loop pre-splits a per-call PRNG key, the
+    slot path folds per-position counters — the two streams are
+    intentionally different (a generate() is one key universe, a slot
+    survives many requests), which is why cross-engine parity tests
+    compare greedy streams only."""
+    B = prompt.shape[0]
+
+    def sample(logits, key, seen):
+        logits = apply_repetition_penalty(logits, seen, rep_penalty)
+        return gumbel_sample(logits, key, temperature, top_k, top_p)
+
+    seen = seen_from_prompt(prompt, prompt_len, cfg.vocab_size)
+    k0, krest = jax.random.split(rng_key)
+    first = sample(next_logits, k0, seen)
+    seen = record_seen(seen, first, rep_penalty)
+
+    def step(carry, key):
+        caches, tok, offset, done, seen = carry
+        # per-row offsets: each row writes its token at its OWN cache
+        # position (batched scatter in decoder_layer) and attends to
+        # its own live prefix — one dispatch decodes a length-ragged
+        # batch (step_forward builds the identical mask/attention the
+        # paged window uses, minus the table indirection)
+        logits, caches = step_forward(
+            params, cfg, tok, offset, caches, cache_len,
+        )
+        nxt = sample(logits, key, seen)
+        seen = record_seen(seen, nxt, rep_penalty)
+        newly_done = (nxt == eos_id) & (eos_id >= 0)
+        nxt = jnp.where(done, eos_id, nxt)
+        done = done | newly_done
+        return (caches, nxt, offset + 1, done, seen), nxt
+
+    done0 = (first == eos_id) & (eos_id >= 0)
+    if max_new > 1:
+        keys = jax.random.split(krest, max_new - 1)
+        (_, _, _, done, _), rest = jax.lax.scan(
+            step,
+            (caches, first, prompt_len, done0, seen),
+            keys,
+            length=max_new - 1,
+        )
+        toks = jnp.concatenate(
+            [first[:, None], rest.swapaxes(0, 1)], axis=1
+        )
+    else:
+        toks = first[:, None]
+    # generated length = tokens up to and including first EOS
+    is_eos = (toks == eos_id) & (eos_id >= 0)
+    first_eos = jnp.where(
+        is_eos.any(axis=1), is_eos.argmax(axis=1) + 1, max_new
+    )
+    return toks, first_eos.astype(jnp.int32)

@@ -56,6 +56,12 @@ class StepRecord:
     compiled: bool  # first dispatch of (phase, bucket) on this profiler
     kv_in_use: int  # sampled pool blocks referenced (-1 = not sampled)
     kv_free: int  # sampled pool free-list size (-1 = not sampled)
+    # model steps fused into this ONE dispatch (decode windows: K; every
+    # other phase: 1). dur_s brackets the whole window, so per-step time
+    # is dur_s / steps and per-token timestamps inside the bracket are
+    # interpolated (docs/OBSERVABILITY.md). Defaulted so records built
+    # by older callers/tests keep their shape.
+    steps: int = 1
 
     def occupancy(self) -> float:
         return self.live_rows / max(1, self.n_slots)
@@ -71,7 +77,7 @@ class StepRecord:
             "n_slots": self.n_slots, "live_tokens": self.live_tokens,
             "padded_tokens": self.padded_tokens, "dur_s": self.dur_s,
             "compiled": self.compiled, "kv_in_use": self.kv_in_use,
-            "kv_free": self.kv_free,
+            "kv_free": self.kv_free, "steps": self.steps,
         }
 
 
@@ -103,7 +109,7 @@ class StepProfiler:
 
     def record(self, phase: str, bucket: int, live_rows: int,
                live_tokens: int, padded_tokens: int,
-               start: float, end: float) -> StepRecord:
+               start: float, end: float, steps: int = 1) -> StepRecord:
         """Append one dispatch record; returns it (tests and the flight
         recorder read fields straight off the return)."""
         kv_in_use, kv_free = self._last_kv
@@ -126,7 +132,7 @@ class StepProfiler:
                 live_rows=live_rows, n_slots=self.n_slots,
                 live_tokens=live_tokens, padded_tokens=padded_tokens,
                 dur_s=max(0.0, end - start), compiled=compiled,
-                kv_in_use=kv_in_use, kv_free=kv_free,
+                kv_in_use=kv_in_use, kv_free=kv_free, steps=steps,
             )
             self._seq += 1
             self._ring.append(rec)
@@ -171,6 +177,13 @@ class StepProfiler:
             sum(r.occupancy() for r in occ_base) / len(occ_base)
             if occ_base else 0.0
         )
+        # denominator = fused model steps (per-ROW token positions),
+        # not live_tokens: live_tokens scales with batch width, which
+        # would make the ratio depend on occupancy. Per-step it is
+        # exactly 1.0 for the single-step loop and 1/K for fused
+        # windows (0.125 at K=8) at any batch width. bench.py
+        # publishes it as decode_dispatches_per_token.
+        decode_steps = sum(r.steps for r in decode)
         return {
             "window_s": window_s,
             "steps": len(win),
@@ -178,6 +191,9 @@ class StepProfiler:
             "batch_occupancy": occupancy,
             "padding_waste_frac": padded / max(1, live + padded),
             "compile_count": self.compile_count,
+            "decode_dispatches_per_token": (
+                len(decode) / decode_steps if decode_steps else 0.0
+            ),
         }
 
     def counter_events(self, pid: int) -> list[dict]:

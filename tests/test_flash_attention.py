@@ -554,6 +554,7 @@ class TestDecodeKernel:
 
         import kubeinfer_tpu.inference.engine as eng_mod
         import kubeinfer_tpu.inference.flash_attention as fa
+        import kubeinfer_tpu.inference.stepper as stepper
         from kubeinfer_tpu.inference import PRESETS, init_params
         from kubeinfer_tpu.inference.engine import Engine
 
@@ -566,8 +567,10 @@ class TestDecodeKernel:
         kern = functools.partial(
             fa.decode_attention, tile_s=8, interpret=True
         )
+        # the decode route resolves its attention in stepper (the one
+        # module all three decode paths share), not engine
         monkeypatch.setattr(
-            eng_mod, "decode_attention_auto",
+            stepper, "decode_attention_auto",
             lambda q, k, v, lengths, mask: kern(q, k, v, lengths),
         )
         eng_mod._generate_jit._clear_cache()

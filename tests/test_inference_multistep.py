@@ -1,0 +1,276 @@
+"""Multi-step fused decode windows: the contracts that let the batcher
+dispatch K tokens at a time without anyone being able to tell.
+
+- **Token identity across horizons.** ``stepper.decode_window`` scans
+  the same per-step program the K=1 loop runs, and the sampling keys
+  are position-folded (admit folds prompt_len, each step folds
+  offset+1) — so every horizon bucket must emit bit-identical streams,
+  greedy AND sampled. References are uncontended engines of the same
+  class with ``max_window=1`` (the per-request Engine has a different
+  key schedule).
+
+- **Host-side EOS masking.** A row whose EOS lands mid-window keeps
+  stepping on device; the host must mask the tail tokens on readback —
+  the emitted stream truncates exactly where the K=1 run's does.
+
+- **Boundary discipline.** Preemption is only checked between windows,
+  max_new is never crossed mid-window (the horizon clamp), and the
+  compile-shape set is exactly one decode shape per window bucket —
+  pinned through the StepProfiler's first-seen compile counter.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from kubeinfer_tpu.inference import PRESETS, init_params
+from kubeinfer_tpu.inference.batching import (
+    ContinuousEngine,
+    PreemptionPolicy,
+)
+from kubeinfer_tpu.inference.stepper import WINDOW_BUCKETS
+from kubeinfer_tpu.observability import tracing
+
+TINY = PRESETS["tiny"]
+
+AGGRESSIVE = PreemptionPolicy(
+    threshold_s=0.0005, objective=0.5, burn_limit=0.5,
+    cooldown_steps=1, min_progress=1,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY, jax.random.PRNGKey(6))
+
+
+def _engine(params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("cache_len", 64)
+    kw.setdefault("block_size", 8)
+    return ContinuousEngine(params, TINY, **kw).start()
+
+
+class TestHorizonPicker:
+    def test_bucket_selection(self, params):
+        # never started: _pick_horizon is pure host policy
+        eng = ContinuousEngine(params, TINY, n_slots=2, cache_len=64,
+                               block_size=8, max_window=8)
+        # largest bucket no row can overshoot
+        assert eng._pick_horizon([12, 9], False) == 8
+        assert eng._pick_horizon([5, 9], False) == 4
+        assert eng._pick_horizon([3], False) == 2
+        assert eng._pick_horizon([1, 30], False) == 1
+        # competing host work collapses the horizon
+        assert eng._pick_horizon([12, 9], True) == 1
+        # no decode rows (all mid-prefill) degrades safely
+        assert eng._pick_horizon([], False) == 1
+
+    def test_max_window_clips_the_bucket_set(self, params):
+        eng = ContinuousEngine(params, TINY, n_slots=2, cache_len=64,
+                               block_size=8, max_window=2)
+        assert eng._window_buckets == (1, 2)
+        assert eng._pick_horizon([30], False) == 2
+        solo = ContinuousEngine(params, TINY, n_slots=2, cache_len=64,
+                                block_size=8, max_window=1)
+        assert solo._pick_horizon([30], False) == 1
+
+    def test_max_window_validation(self, params):
+        with pytest.raises(ValueError, match="max_window"):
+            ContinuousEngine(params, TINY, n_slots=2, cache_len=64,
+                             block_size=8, max_window=0)
+
+
+class TestWindowParity:
+    def test_k4_parity_greedy_and_sampled(self, params):
+        """The fast tier-1 parity pin: K=4 windows vs the single-step
+        loop, greedy and sampled, same engine class."""
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(0, TINY.vocab_size, 9).tolist()
+        ref = _engine(params, max_window=1)
+        try:
+            want_g = ref.generate(prompt, max_new_tokens=9)
+            want_s = ref.generate(prompt, max_new_tokens=9,
+                                  temperature=0.8, seed=5, top_k=13)
+        finally:
+            ref.stop()
+        eng = _engine(params, max_window=4)
+        try:
+            got_g = eng.generate(prompt, max_new_tokens=9)
+            got_s = eng.generate(prompt, max_new_tokens=9,
+                                 temperature=0.8, seed=5, top_k=13)
+            windows = eng.scheduler_stats()["windows"]
+            buckets = {r.bucket for r in eng.profiler.snapshot()
+                       if r.phase == "decode"}
+        finally:
+            eng.stop()
+        assert got_g == want_g
+        assert got_s == want_s
+        # the run must actually fuse: 8 post-admit tokens = 4+4, fewer
+        # dispatches than tokens
+        assert buckets == {4}
+        assert windows == 4  # two generates x (4 + 4)
+
+    @pytest.mark.slow
+    def test_all_buckets_parity_greedy_and_sampled(self, params):
+        """Full sweep: every window bucket vs K=1, greedy + sampled +
+        top-p + repetition penalty, bit-identical streams."""
+        rng = np.random.default_rng(12)
+        prompt = rng.integers(0, TINY.vocab_size, 7).tolist()
+        sample_kw = [
+            dict(),
+            dict(temperature=0.9, seed=3, top_k=17),
+            dict(temperature=0.7, seed=8, top_p=0.8),
+            dict(temperature=1.1, seed=4, repetition_penalty=1.3),
+        ]
+        ref = _engine(params, max_window=1)
+        try:
+            want = [ref.generate(prompt, max_new_tokens=13, **kw)
+                    for kw in sample_kw]
+        finally:
+            ref.stop()
+        for k in WINDOW_BUCKETS[1:]:
+            eng = _engine(params, max_window=k)
+            try:
+                got = [eng.generate(prompt, max_new_tokens=13, **kw)
+                       for kw in sample_kw]
+            finally:
+                eng.stop()
+            assert got == want, f"stream diverged at max_window={k}"
+
+
+class TestEosMidWindow:
+    def test_tail_tokens_masked_on_readback(self, params):
+        """Pick an EOS id the greedy stream emits mid-window (position
+        2 of 12, well inside the first 8-wide window) and check the
+        fused run truncates exactly like the single-step run."""
+        rng = np.random.default_rng(13)
+        prompt = rng.integers(0, TINY.vocab_size, 6).tolist()
+        ref = _engine(params, max_window=1)
+        try:
+            free_run = ref.generate(prompt, max_new_tokens=12)
+            eos = free_run[2]
+            assert eos not in free_run[:2]  # truncation point is exact
+            want = ref.generate(prompt, max_new_tokens=12, eos_id=eos)
+        finally:
+            ref.stop()
+        eng = _engine(params, max_window=8)
+        try:
+            req = eng.serve(prompt, max_new_tokens=12, eos_id=eos)
+            recs = [r for r in eng.profiler.snapshot()
+                    if r.phase == "decode"]
+        finally:
+            eng.stop()
+        assert want == free_run[:3]
+        assert req.out_tokens == want
+        # the request's timeline never saw the masked tail
+        assert len(req.token_times) == len(req.out_tokens)
+        # the window that crossed the EOS reported its masked tail as
+        # padding, not live tokens
+        assert any(
+            r.steps > 1 and r.live_tokens < r.live_rows * r.steps
+            for r in recs
+        )
+
+
+class TestWindowBoundaries:
+    def test_preemption_lands_at_window_boundaries(self, params):
+        """20+ park cycles against fused windows: parks only happen
+        between windows (the preempt check runs at pass top), so every
+        request — parked, resumed, re-parked — still emits exactly the
+        uncontended stream."""
+        rng = np.random.default_rng(14)
+        prompts = [
+            rng.integers(0, TINY.vocab_size, 5).tolist()
+            for _ in range(16)
+        ]
+        solo = _engine(params, max_window=8)
+        try:
+            want = [
+                solo.generate(p, max_new_tokens=10,
+                              temperature=0.8 if i % 2 else 0.0,
+                              seed=50 + i, top_k=9 if i % 2 else 0)
+                for i, p in enumerate(prompts)
+            ]
+        finally:
+            solo.stop()
+        eng = _engine(params, max_window=8, preemption=AGGRESSIVE)
+        try:
+            reqs = [
+                eng.submit(p, max_new_tokens=10,
+                           temperature=0.8 if i % 2 else 0.0,
+                           seed=50 + i, top_k=9 if i % 2 else 0)
+                for i, p in enumerate(prompts)
+            ]
+            for i, r in enumerate(reqs):
+                assert r.done.wait(300), f"request {i} starved"
+                assert not r.failed
+            preempted = eng.preempted_total
+            resumed = eng.resumed_total
+        finally:
+            eng.stop()
+        assert preempted >= 20, f"only {preempted} park cycles"
+        assert resumed == preempted
+        for i, r in enumerate(reqs):
+            assert r.out_tokens == want[i], f"request {i}"
+
+    @pytest.mark.slow
+    def test_one_compiled_shape_per_window_bucket(self, params):
+        """Shape discipline: decode dispatches use exactly the window
+        buckets (bucket == K), and repeating an already-seen workload
+        registers ZERO fresh (phase, bucket) first-seens."""
+        rng = np.random.default_rng(15)
+        prompt = rng.integers(0, TINY.vocab_size, 9).tolist()
+        eng = _engine(params, max_window=8)
+        try:
+            eng.generate(prompt, max_new_tokens=12)  # 11 post-admit: 8+2+1
+            buckets = {r.bucket for r in eng.profiler.snapshot()
+                       if r.phase == "decode"}
+            assert buckets == {8, 2, 1}
+            assert buckets <= set(WINDOW_BUCKETS)
+            c0 = eng.profiler.compile_count
+            eng.generate(prompt, max_new_tokens=12)
+            assert eng.profiler.compile_count == c0
+            # a different budget reuses the same bucket set: 5 post-
+            # admit tokens = 4+1, where 4 is a fresh first-seen shape
+            eng.generate(prompt, max_new_tokens=6)
+            assert eng.profiler.compile_count == c0 + 1
+            eng.generate(prompt, max_new_tokens=6)
+            assert eng.profiler.compile_count == c0 + 1
+        finally:
+            eng.stop()
+
+
+class TestInterpolatedTimestamps:
+    def test_token_times_and_span_attr(self, params):
+        """Fused windows observe one clock bracket per K tokens:
+        per-token times are interpolated (monotone, inside the
+        bracket) and both the request and its decode span say so —
+        K=1 engines stamp real per-step times and stay unmarked."""
+        rng = np.random.default_rng(16)
+        prompt = rng.integers(0, TINY.vocab_size, 6).tolist()
+        eng = _engine(params, max_window=8)
+        try:
+            req = eng.serve(prompt, max_new_tokens=10)
+        finally:
+            eng.stop()
+        assert req.interpolated
+        assert len(req.token_times) == 10
+        assert all(
+            a <= b for a, b in
+            zip(req.token_times, req.token_times[1:])
+        )
+        spans = [
+            s for s in tracing.RECORDER.snapshot()
+            if s.name == "engine.decode"
+            and s.attrs.get("kubeinfer.interpolated")
+        ]
+        assert spans, "decode span missing kubeinfer.interpolated"
+        ref = _engine(params, max_window=1)
+        try:
+            req1 = ref.serve(prompt, max_new_tokens=10)
+        finally:
+            ref.stop()
+        assert not req1.interpolated

@@ -324,11 +324,15 @@ class TestEngineInstrumentation:
         assert pre.dur_s >= 0.0
         for d in (r for r in recs if r.phase == "decode"):
             # this engine is n_slots=2: one live request decodes at
-            # half occupancy, one padded row
+            # half occupancy; the record covers ONE fused window of
+            # d.steps model steps (bucket == the compiled horizon K)
             assert d.n_slots == 2
+            assert d.bucket == d.steps >= 1
             assert d.live_rows >= 1
-            assert d.live_tokens == d.live_rows
-            assert d.live_rows + d.padded_tokens == 2
+            # every live row lands at least its first window token; a
+            # mid-window EOS masks the tail into padding
+            assert d.live_rows <= d.live_tokens <= d.live_rows * d.steps
+            assert d.live_tokens + d.padded_tokens == 2 * d.steps
 
     def test_flight_recorder_sees_the_request_lifecycle(self, engine):
         n_before = len(engine.flight)
