@@ -49,6 +49,9 @@ _GAUGE_SUFFIXES = _UNIT_SUFFIXES + (
     "_total", "_replicas", "_ratio", "_size", "_state", "_requests",
     "_drafts", "_in_use", "_free", "_frac", "_rate", "_remaining",
     "_depth", "_occupancy", "_per_second",
+    # device-layout gauges (tensor-parallel serving): a tp degree and a
+    # device count are self-describing dimensionless quantities
+    "_degree", "_devices",
 )
 
 _KINDS = ("Counter", "Gauge", "Histogram")

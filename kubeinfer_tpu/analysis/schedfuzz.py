@@ -580,6 +580,108 @@ def _scn_engine_multistep(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_sharded_window(fz: SchedFuzzer):
+    """Staged-admission drain racing a /metrics scrape while a
+    tensor-parallel window is in flight (server._refresh_spec_metrics
+    against batching's double-buffered admission, sharded layout).
+
+    The sharded engine adds a reader to the multistep protocol: the
+    metrics thread walks the tp shard labels publishing per-shard
+    kv-blocks gauges. Block ids are LOGICAL (kv_blocks.py device-layout
+    audit), so every shard label must report the SAME count within one
+    scrape — production guarantees it by snapshotting kv_cache_stats()
+    ONCE per scrape and fanning the value out to each label, never one
+    pool read per label (labels would disagree whenever an alloc lands
+    between reads). The scrape also holds the engine lock, so the
+    staged list and the pool occupancy it observes are one coherent
+    moment: occupancy can exceed 2x staged (a drain batch unrefs
+    outside the lock) but never undercut it. Admission invariants are
+    the multistep ones: refs balance to zero, exactly one terminal
+    state per request. Lock order stays engine->pool on every thread —
+    a scrape taking them the other way would trip the cycle oracle.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool
+
+    tp = 4
+    pool = BlockPool(32, 4)
+    lock = make_lock("schedfuzz.engine-sharded-window._lock")
+    pending: list[int] = []
+    staged: list[tuple[int, list[int]]] = []
+    served: list[int] = []
+    failed: list[int] = []
+    scrapes: list[tuple] = []
+    state = {"stopped": False}
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def scheduler() -> None:
+        for _ in range(10):
+            # overlap phase: the sharded window is in flight on the
+            # mesh; admissions are planned host-side under the lock
+            with lock:
+                if state["stopped"]:
+                    return
+                if pending:
+                    staged.append((pending.pop(0), pool.alloc(2)))
+            # window boundary: drain the staged plans (batch owned by
+            # this thread once popped)
+            with lock:
+                if state["stopped"]:
+                    return
+                batch = staged[:]
+                staged.clear()
+            for rid, blocks in batch:
+                pool.unref(blocks)
+                with lock:
+                    served.append(rid)
+
+    def scraper() -> None:
+        for _ in range(4):
+            with lock:
+                in_use = pool.used_blocks  # ONE snapshot per scrape
+                floor = 2 * len(staged)
+                scrapes.append((floor, tuple(in_use for _ in range(tp))))
+
+    def stopper() -> None:
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            swept = staged[:]
+            staged.clear()
+            leftover = pending[:]
+            pending.clear()
+        for rid, blocks in swept:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("sched", scheduler)
+    fz.spawn("scrape", scraper)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not staged and not pending, (staged, pending)
+        assert sorted(served + failed) == list(range(6)), (served, failed)
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+        for floor, shards in scrapes:
+            assert len(set(shards)) == 1, shards
+            assert shards[0] >= floor, (shards[0], floor)
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -590,6 +692,7 @@ SCENARIOS = [
     Scenario("fault-burst", _scn_fault_burst),
     Scenario("registry-scrape", _scn_registry_scrape),
     Scenario("engine-multistep", _scn_engine_multistep),
+    Scenario("engine-sharded-window", _scn_engine_sharded_window),
 ]
 
 

@@ -58,6 +58,7 @@ from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 from kubeinfer_tpu.observability.slo import SLOMonitor, SLOObjective
 from kubeinfer_tpu.observability.stepprof import StepProfiler
+from kubeinfer_tpu.inference.sharding import EngineLayout
 from kubeinfer_tpu.inference.stepper import (
     SlotState,
     WINDOW_BUCKETS,
@@ -384,8 +385,18 @@ class ContinuousEngine:
                  num_blocks: int | None = None,
                  prefill_chunk_blocks: int = 0,
                  preemption: PreemptionPolicy | None = None,
-                 max_window: int = 8) -> None:
-        self.params = params
+                 max_window: int = 8,
+                 layout: EngineLayout | None = None) -> None:
+        # device layout (sharding.EngineLayout): tp=1 (the default) is
+        # meshless and every placement below is the identity — the
+        # engine is byte-for-byte the single-device engine. Under tp>1
+        # the layout places params (Megatron specs) and the slot state
+        # (pool along n_kv, rest replicated); the jits themselves are
+        # unchanged and GSPMD partitions from the input shardings.
+        self.layout = layout if layout is not None else EngineLayout()
+        self.layout.check_model(cfg)
+        self._sharded = self.layout.sharded
+        self.params = self.layout.shard_params(params, cfg)
         self.cfg = cfg
         self.n_slots = n_slots
         self.cache_len = cache_len
@@ -508,10 +519,10 @@ class ContinuousEngine:
         # preemption interleaves parked readmits with fresh arrivals,
         # so two unplaced requests can be in hand at once.
         self._holdover: "collections.deque[_Request]" = collections.deque()
-        self._state = init_slot_state(
+        self._state = self.layout.shard_state(init_slot_state(
             cfg, n_slots, cache_len, params["norm"].dtype,
             num_blocks, self.block_size,
-        )
+        ))
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slot_req: list[_Request | None] = [None] * n_slots
         self._stop = threading.Event()
@@ -665,6 +676,11 @@ class ContinuousEngine:
         return {
             "n_slots": self.n_slots,
             "block_size": self.block_size,
+            # device layout, advertised so the fleet router / capacity
+            # dashboards can tell a tp=4 replica's pool shard from a
+            # single-device pool of the same logical block count
+            "tp_degree": self.layout.tp,
+            "mesh_devices": self.layout.mesh_devices,
             "queue_depth": self._queue.qsize() + waiting,
             "batch_occupancy": round(prof["batch_occupancy"], 6),
             "goodput_tokens_per_sec": round(
@@ -1626,7 +1642,8 @@ class ContinuousEngine:
                 step_t0 = tracing.now()
                 # lint: allow[lock-discipline] scheduler thread is the only _state writer; see comment above
                 self._state, tokens = decode_window(
-                    self.params, self._state, self.cfg, k
+                    self.params, self._state, self.cfg, k,
+                    sharded=self._sharded,
                 )
                 # the dispatch returns a future immediately (JAX async
                 # dispatch): the admission planning below is the host

@@ -602,14 +602,21 @@ def decode_flash_available(S: int, D: int) -> bool:
     )
 
 
-def decode_attention_auto(q, k, v, lengths, mask):
+def decode_attention_auto(q, k, v, lengths, mask, gspmd=False):
     """Decode-step attention router: the length-clamped Pallas kernel
     when shapes/backend allow, dense jnp over ``mask`` otherwise. The
     flash branch never reads ``mask`` — XLA dead-code-eliminates its
     construction (the chunked_prefill contract). ``lengths`` and
     ``mask`` must describe the same live set (mask[b] true exactly on
-    slots < lengths[b]) or the two branches diverge."""
-    if q.shape[1] == 1 and decode_flash_available(k.shape[1], q.shape[3]):
+    slots < lengths[b]) or the two branches diverge.
+
+    ``gspmd=True`` pins the dense branch: a caller tracing under a
+    sharded jit needs every op partitionable, and a Pallas kernel is a
+    custom call GSPMD cannot split over heads — it would replicate (or
+    fail to lower), same constraint forward_tensor_parallel documents
+    for the prefill kernel."""
+    if (not gspmd and q.shape[1] == 1
+            and decode_flash_available(k.shape[1], q.shape[3])):
         return decode_attention(q, k, v, lengths)
     return dense_attention(q, k, v, mask)
 
@@ -851,13 +858,21 @@ def decode_blocks_available(block_size: int, D: int) -> bool:
 
 
 def decode_attention_blocks_auto(q, k_pool, v_pool, block_tables, lengths,
-                                 mask):
+                                 mask, gspmd=False):
     """Paged decode-step router: the block-table Pallas kernel when
     shapes/backend allow, gather-through-the-table + dense jnp over
     ``mask`` otherwise. The flash branch never reads ``mask`` (XLA
     dead-code-eliminates its construction); ``lengths`` and ``mask``
-    must describe the same live set, per decode_attention_auto."""
-    if q.shape[1] == 1 and decode_blocks_available(
+    must describe the same live set, per decode_attention_auto.
+
+    ``gspmd=True`` pins the gather+dense branch (the sharded engine's
+    route): the table gather indexes the pool's REPLICATED num_blocks
+    axis, so with the pool sharded along n_kv each device gathers its
+    own heads' slice of the named blocks through the same host i32
+    tables, and the dense einsum partitions over heads — whereas the
+    block kernel is a custom call GSPMD cannot split (see
+    decode_attention_auto)."""
+    if (not gspmd) and q.shape[1] == 1 and decode_blocks_available(
         k_pool.shape[1], q.shape[3]
     ):
         return decode_attention_blocks(

@@ -26,6 +26,17 @@ Reference counting contract:
 Lock order (outermost first): ContinuousEngine._lock ->
 RadixCache._lock -> BlockPool._lock. The trie calls into the pool under
 its own lock; nothing here calls back out.
+
+Device-layout audit (tensor-parallel serving): every block id in this
+module is LOGICAL — an index into the pool array's replicated leading
+``num_blocks`` axis. Under a sharded EngineLayout the pool tensor
+shards along its ``n_kv`` axis (each device holds its own heads' slice
+of every block); the leading axis is whole on every device, so the
+same i32 tables, refcounts, fingerprints, and LRU decisions drive
+every shard identically and nothing in this file may ever branch on
+the layout. Anything that would make block ids device-relative (e.g.
+per-shard free lists) breaks the radix cache's cross-slot sharing and
+the preemption park/resume contract in one stroke.
 """
 
 from __future__ import annotations
@@ -98,6 +109,8 @@ class BlockPool:
     Pure bookkeeping — the actual [num_blocks, block_size, n_kv, D]
     device tensors live in the engine's SlotState; indices handed out
     here are what the block tables (and the Pallas index_map) resolve.
+    Indices are logical per the module's device-layout audit: one pool,
+    whatever the tensor's sharding.
     """
 
     def __init__(self, num_blocks: int, block_size: int) -> None:

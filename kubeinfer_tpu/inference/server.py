@@ -138,6 +138,28 @@ def _serving_metrics(registry: Registry):
             "KV pool blocks on the free list",
             registry=registry,
         ),
+        # device layout of the continuous batcher (sharding.EngineLayout):
+        # capacity dashboards need the tp degree next to the pool gauges —
+        # a tp=4 replica's blocks_in_use counts LOGICAL blocks whose bytes
+        # are split 4 ways, so per-device headroom math divides by tp
+        "tp_degree": Gauge(
+            "kubeinfer_engine_tp_degree",
+            "Tensor-parallel degree of the serving engine's device "
+            "layout (1 = unsharded)",
+            registry=registry,
+        ),
+        "mesh_devices": Gauge(
+            "kubeinfer_mesh_devices",
+            "Devices in the serving mesh (1 when unsharded)",
+            registry=registry,
+        ),
+        "kv_shard_blocks_in_use": Gauge(
+            "kubeinfer_kv_shard_blocks_in_use",
+            "KV pool blocks referenced per tensor-parallel shard; block "
+            "indices are logical, so every shard references the same "
+            "block set and holds n_kv/tp heads of each",
+            labels=("shard",), registry=registry,
+        ),
         "prefix_hits": Counter(
             "kubeinfer_prefix_cache_hits_total",
             "Admits that reused >= 1 cached prefix block",
@@ -439,6 +461,18 @@ class InferenceServer:
         stats = self.continuous.kv_cache_stats()
         self.metrics["kv_blocks_in_use"].set(stats["blocks_in_use"])
         self.metrics["kv_blocks_free"].set(stats["blocks_free"])
+        layout = self.continuous.layout
+        self.metrics["tp_degree"].set(layout.tp)
+        self.metrics["mesh_devices"].set(layout.mesh_devices)
+        # one series per shard, all reporting the same logical count:
+        # the pool's bookkeeping is layout-agnostic (kv_blocks.py), so a
+        # shard's referenced-block set IS the pool's — the per-shard
+        # fan-out exists so dashboards aggregating by device see the
+        # sharded pool instead of inferring it from tp_degree
+        for shard in range(layout.tp):
+            self.metrics["kv_shard_blocks_in_use"].set(
+                str(shard), stats["blocks_in_use"]
+            )
         summary = self.continuous.stats_summary()
         self.metrics["goodput"].set(summary["goodput_tokens_per_sec"])
         self.metrics["occupancy"].set(summary["batch_occupancy"])
@@ -893,12 +927,23 @@ def main(argv: list[str] | None = None) -> int:
         preemption = None
         if args.preemption_slo:
             preemption = PreemptionPolicy.parse(args.preemption_slo)
+        layout = None
+        if args.tensor_parallel_size > 1:
+            # the real --tensor-parallel path (the reference forwards
+            # the flag to external vLLM, vllm.go:57-61; we own the
+            # partition): reuse the (dp, tp, sp) mesh built above so
+            # the batcher, the per-request engine, and the draft all
+            # place onto the same devices
+            from kubeinfer_tpu.inference.sharding import EngineLayout
+
+            layout = EngineLayout(tp=args.tensor_parallel_size, mesh=mesh)
         continuous = ContinuousEngine(
             params, cfg, n_slots=args.batch_slots,
             cache_len=min(max_cache, 4096),
             speculative=speculative,
             prefill_chunk_blocks=args.prefill_chunk_blocks,
             preemption=preemption,
+            layout=layout,
         )
         if args.prewarm_spec and speculative is not None:
             sizes = tuple(

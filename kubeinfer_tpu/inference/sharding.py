@@ -1,4 +1,5 @@
-"""Sharding for the native runtime: TP param specs + SP forward.
+"""Sharding for the native runtime: TP param specs + SP forward +
+the continuous batcher's device layout.
 
 Tensor parallel (the reference's ``--tensor-parallel-size`` is a
 pass-through flag to external vLLM, vllm.go:57-61; here TP is real):
@@ -7,6 +8,15 @@ column-parallel (q/k/v/gate/up) then row-parallel (o/down) weights, the
 only collectives GSPMD must insert are the two per-block psums of the
 standard Megatron layout — we annotate the params and let the partitioner
 do exactly that (scaling-book recipe: annotate, don't hand-schedule).
+
+:class:`EngineLayout` extends the same recipe to the serving engine's
+paged state: params per :func:`param_specs`, the shared KV block pool
+``[num_blocks, block_size, n_kv, D]`` sharded along ``n_kv`` (each
+device holds its own heads' slice of EVERY block — block indices stay
+logical and host bookkeeping never sees the layout), everything else
+replicated. The engine's jits (admit, chunk, decode window) take the
+placed arrays and GSPMD propagates — one extra compiled executable per
+layout, no trace changes.
 
 Sequence parallel: ``forward_sequence_parallel`` runs the whole decoder
 under ``shard_map`` with the sequence axis sharded over ``sp``, swapping
@@ -17,6 +27,7 @@ sequence on one device — this is the long-context path.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -29,12 +40,72 @@ from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.inference.ring_attention import ring_attention
 
 
+def order_devices_ici(devices) -> list:
+    """Devices reordered along a boustrophedon walk of the chip grid so
+    consecutive ranks are ICI neighbors (the ordering make_axis_mesh's
+    docstring deferred).
+
+    ``jax.devices()`` enumerates TPU chips in row-major coordinate
+    order, so the wrap from the end of one row to the start of the next
+    puts consecutive mesh ranks on chips a full row apart — every
+    collective then pays a multi-hop detour on exactly the axis that is
+    supposed to be latency-critical. The snake walk flips direction on
+    alternate rows (and alternate planes, for 3D slices), keeping every
+    consecutive pair one ICI hop apart; cores on the same chip sort
+    adjacent, which is tighter still. Devices without chip coords
+    (CPU/virtual meshes, the 8-device test mesh) keep their enumeration
+    order — on those platforms there is no topology to respect and the
+    stable order keeps layouts reproducible.
+    """
+    coords = [getattr(d, "coords", None) for d in devices]
+    if any(c is None for c in coords):
+        return list(devices)
+    sizes = [max(c[i] for c in coords) + 1 for i in range(len(coords[0]))]
+
+    def snake_rank(c) -> int:
+        # walk dims slowest-to-fastest (TPU coords are (x, y, z): z is
+        # the slowest axis); a dim entered at an odd index reverses the
+        # next-faster dim, which is what makes row ends adjacent
+        rank, flip = 0, False
+        for i in reversed(range(len(sizes))):
+            v = (sizes[i] - 1 - c[i]) if flip else c[i]
+            rank = rank * sizes[i] + v
+            flip = (v % 2) == 1
+        return rank
+
+    return sorted(
+        devices,
+        key=lambda d: (snake_rank(d.coords),
+                       getattr(d, "core_on_chip", 0)),
+    )
+
+
+def mesh_device_array(devices, dp: int, tp: int, sp: int):
+    """ICI-ordered ``(dp, tp, sp)`` device array with ``tp`` ranks
+    adjacent on the physical chain.
+
+    A plain ``reshape(dp, tp, sp)`` makes ``sp`` the fastest-varying
+    axis; filling ``(dp, sp, tp)`` and transposing instead puts
+    consecutive ``tp`` ranks on consecutive chain positions — the tp
+    axis carries the per-layer Megatron psums (two per block, every
+    step), while sp/dp collectives are per-request-scale, so tp gets
+    the single-hop neighbors. When sp == 1 the transpose is the
+    identity and the array matches the historical layout exactly.
+    Factored from make_inference_mesh so topology tests can drive it
+    with fake devices.
+    """
+    import numpy as np
+
+    ordered = order_devices_ici(devices)[: dp * tp * sp]
+    return np.asarray(ordered).reshape(dp, sp, tp).transpose(0, 2, 1)
+
+
 def make_inference_mesh(
     tp: int = 1, sp: int = 1, dp: int | None = None
 ) -> Mesh:
-    """(dp, tp, sp) mesh over the available devices (dp fills the rest)."""
-    import numpy as np
-
+    """(dp, tp, sp) mesh over the available devices (dp fills the rest),
+    ICI-ordered so adjacent tp ranks sit on adjacent devices
+    (order_devices_ici / mesh_device_array)."""
     devices = jax.devices()
     if dp is None:
         dp = len(devices) // (tp * sp)
@@ -45,15 +116,15 @@ def make_inference_mesh(
             f"{len(devices)}"
         )
     return Mesh(
-        np.asarray(devices[:n]).reshape(dp, tp, sp),
+        mesh_device_array(devices, dp, tp, sp),
         axis_names=("dp", "tp", "sp"),
     )
 
 
 def make_axis_mesh(axis_name: str, n: int) -> Mesh:
-    """1-D mesh over the first ``n`` devices (shared by the pp/ep
-    constructors — one place for device-count checks and, later, any
-    ICI-locality device ordering)."""
+    """1-D mesh over the first ``n`` devices in ICI order (shared by the
+    pp/ep constructors — one place for device-count checks and the
+    locality ordering)."""
     import numpy as np
 
     devices = jax.devices()
@@ -61,7 +132,10 @@ def make_axis_mesh(axis_name: str, n: int) -> Mesh:
         raise ValueError(
             f"{axis_name}={n} needs {n} devices, have {len(devices)}"
         )
-    return Mesh(np.asarray(devices[:n]).reshape(n), axis_names=(axis_name,))
+    return Mesh(
+        np.asarray(order_devices_ici(devices)[:n]).reshape(n),
+        axis_names=(axis_name,),
+    )
 
 
 def param_specs(cfg: ModelConfig) -> Params:
@@ -110,6 +184,120 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineLayout:
+    """Device layout of the continuous batcher: mesh + placements for
+    params, the paged KV pool, and the rest of the slot state.
+
+    ``tp == 1`` is the degenerate single-device layout: no mesh exists,
+    ``shard_params``/``shard_state`` return their inputs untouched, and
+    the engine is byte-for-byte the pre-sharding engine — same arrays,
+    same traces, same compile cache. Under ``tp > 1`` the layout only
+    PLACES arrays; it never rewrites the engine's programs. Params
+    follow :func:`param_specs` (Megatron column/row parallel), the
+    per-layer pool ``[num_blocks, block_size, n_kv, D]`` shards along
+    ``n_kv`` (dim 2), and every other SlotState leaf — block tables,
+    sampling knobs, PRNG keys — replicates. Because the ``num_blocks``
+    axis is whole on every device, the host's i32 block tables resolve
+    per-device KV shards unchanged: a table entry names the same
+    logical block everywhere, each device just gathers/scatters its own
+    heads' slice of it. That is the whole reason BlockPool/RadixCache
+    never learn about the layout.
+
+    Token parity with tp=1 is by dominance, not bit-exactness of the
+    logits: GSPMD's psum reduces partial products in a different order
+    than the unsharded contraction, so logits can differ in the last
+    ulps — but the sampling noise is position-folded (identical across
+    layouts) and argmax/gumbel-pick decisions ride logit GAPS, which
+    the parity suite pins greedy and sampled across admits, windows,
+    and preemption cycles.
+    """
+
+    tp: int = 1
+    mesh: Mesh | None = None
+
+    def __post_init__(self) -> None:
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if (self.mesh is None) != (self.tp == 1):
+            raise ValueError(
+                "EngineLayout carries a mesh exactly when tp > 1 "
+                f"(tp={self.tp}, mesh={'set' if self.mesh else 'None'})"
+            )
+
+    @classmethod
+    def build(cls, tp: int = 1) -> "EngineLayout":
+        """The CLI/bench constructor: tp=1 stays meshless (zero
+        behavior change), tp>1 builds the ICI-ordered serving mesh."""
+        if tp <= 1:
+            return cls()
+        return cls(tp=tp, mesh=make_inference_mesh(tp=tp, sp=1, dp=1))
+
+    @property
+    def sharded(self) -> bool:
+        return self.mesh is not None
+
+    @property
+    def mesh_devices(self) -> int:
+        """Device count under the layout (1 when unsharded) — what the
+        kubeinfer_mesh_devices gauge reports."""
+        return 1 if self.mesh is None else self.mesh.size
+
+    def check_model(self, cfg: ModelConfig) -> None:
+        """Divisibility the layout needs: every device must own whole
+        heads. n_kv % tp == 0 keeps the pool shards real (a device with
+        zero KV heads would still pay every collective); GQA ratios
+        where n_kv == tp (one KV head per device) are the floor."""
+        if not self.sharded:
+            return
+        if cfg.num_attention_heads % self.tp:
+            raise ValueError(
+                f"tp={self.tp} must divide num_attention_heads="
+                f"{cfg.num_attention_heads}"
+            )
+        if cfg.num_key_value_heads % self.tp:
+            raise ValueError(
+                f"tp={self.tp} must divide num_key_value_heads="
+                f"{cfg.num_key_value_heads} (KV pool shards along n_kv)"
+            )
+
+    def shard_params(self, params: Params, cfg: ModelConfig) -> Params:
+        """Place params per param_specs; identity when unsharded."""
+        if not self.sharded:
+            return params
+        return shard_params(params, self.mesh, cfg)
+
+    def pool_sharding(self) -> NamedSharding:
+        """[num_blocks, block_size, n_kv, D]: heads shard, blocks stay
+        whole per device so logical table indices resolve everywhere."""
+        return NamedSharding(self.mesh, P(None, None, "tp", None))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_state(self, state):
+        """Place a stepper.SlotState; identity when unsharded. The
+        placement is the jit contract: decode_window/_admit_slot donate
+        this pytree, and jax compiles one executable per distinct input
+        sharding — which is exactly the one-shape-per-(bucket, layout)
+        discipline the profiler pins."""
+        if not self.sharded:
+            return state
+        pool = self.pool_sharding()
+        rep = self.replicated()
+        placed = {
+            f.name: jax.device_put(getattr(state, f.name), rep)
+            for f in dataclasses.fields(state)
+            if f.name not in ("caches_k", "caches_v")
+        }
+        return dataclasses.replace(
+            state,
+            caches_k=[jax.device_put(c, pool) for c in state.caches_k],
+            caches_v=[jax.device_put(c, pool) for c in state.caches_v],
+            **placed,
+        )
 
 
 def forward_tensor_parallel(

@@ -169,6 +169,7 @@ def step_forward(
     kv_caches,  # per-layer (k, v): dense [B, S, ...] or paged pool
     cache_len: int,  # logical per-row cache width S
     block_tables: jax.Array | None = None,  # i32[B, max_blocks] = paged
+    sharded: bool = False,  # caller jits under a tp-sharded EngineLayout
 ):
     """One decode token's forward pass for a length-ragged batch;
     returns (logits f32[B, V], updated kv_caches).
@@ -179,18 +180,25 @@ def step_forward(
     ``block_tables`` is given) and attend to positions ``< offset + 1``.
     On TPU the decode kernels DMA only each row's live tiles (the
     lengths operand == the mask's live set); the bool mask remains the
-    dense fallback operand."""
+    dense fallback operand.
+
+    ``sharded`` pins the attention routers' GSPMD-partitionable branch
+    (flash_attention: Pallas custom calls cannot be split over heads) —
+    the rest of the trace is einsums and the table scatter/gather,
+    which partition over the pool's n_kv axis as-is."""
     B = tok.shape[0]
     mask = (jnp.arange(cache_len)[None, None, :]
             < (offset + 1)[:, None, None])
     mask = jnp.broadcast_to(mask, (B, 1, cache_len))
     if block_tables is None:
         def attn_fn(q, k, v, m):
-            return decode_attention_auto(q, k, v, offset + 1, m)
+            return decode_attention_auto(
+                q, k, v, offset + 1, m, gspmd=sharded
+            )
     else:
         def attn_fn(q, k, v, m):
             return decode_attention_blocks_auto(
-                q, k, v, block_tables, offset + 1, m
+                q, k, v, block_tables, offset + 1, m, gspmd=sharded
             )
     logits, kv_caches = forward(
         params, tok[:, None], cfg,
@@ -208,7 +216,8 @@ def step_forward(
 
 
 def decode_body(
-    params: Params, state: SlotState, cfg: ModelConfig
+    params: Params, state: SlotState, cfg: ModelConfig,
+    sharded: bool = False,
 ) -> tuple[SlotState, jax.Array]:
     """One token for every active slot (greedy, or per-slot temperature
     sampling keyed by the slot PRNG + offset); returns (state, tokens).
@@ -222,7 +231,7 @@ def decode_body(
     logits, caches = step_forward(
         params, cfg, state.last_token, state.offset,
         list(zip(state.caches_k, state.caches_v)), S,
-        block_tables=state.tables,
+        block_tables=state.tables, sharded=sharded,
     )
     new_k = [c[0] for c in caches]
     new_v = [c[1] for c in caches]
@@ -262,10 +271,11 @@ def decode_body(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "k"), donate_argnums=(1,)
+    jax.jit, static_argnames=("cfg", "k", "sharded"), donate_argnums=(1,)
 )
 def decode_window(
-    params: Params, state: SlotState, cfg: ModelConfig, k: int
+    params: Params, state: SlotState, cfg: ModelConfig, k: int,
+    sharded: bool = False,
 ) -> tuple[SlotState, jax.Array]:
     """K fused decode steps in ONE dispatch; returns (state, i32[B, K]).
 
@@ -278,10 +288,20 @@ def decode_window(
     keeps scattering into its own refcounted blocks — positions nobody
     will ever read, since the host masks the tail tokens on readback
     and the horizon clamp keeps every write inside the row's allocated
-    block span. -1 marks inactive rows' tokens, exactly as at K=1."""
+    block span. -1 marks inactive rows' tokens, exactly as at K=1.
+
+    Under a sharded EngineLayout the ENGINE passes ``sharded=True`` and
+    a SlotState whose leaves are placed (pool along n_kv, rest
+    replicated): jit keys the executable on those input shardings, so
+    the donated scan carry keeps its placement across windows and the
+    compiled-shape set stays one per (K-bucket, layout) — the same
+    donation discipline as tp=1, with GSPMD's psums inside the scan
+    body. The static flag only pins the attention routers' dense
+    branch; at tp=1 its False default leaves the trace byte-identical
+    to the pre-layout engine."""
 
     def step(st, _):
-        return decode_body(params, st, cfg)
+        return decode_body(params, st, cfg, sharded)
 
     state, toks = jax.lax.scan(step, state, None, length=k)
     # scan stacks on the leading (time) axis; callers want [slot, step]

@@ -354,8 +354,13 @@ class TestEngineInstrumentation:
             "goodput_tokens_per_sec", "padding_waste_frac",
             "kv_blocks_free", "kv_blocks_in_use", "prefix_hit_rate",
             "prefix_cached_tokens", "cache_summary",
+            "tp_degree", "mesh_devices",
         }
         assert s["n_slots"] == 2
+        # unsharded engine: the layout gauges report the degenerate
+        # single-device layout, not an absent one
+        assert s["tp_degree"] == 1
+        assert s["mesh_devices"] == 1
         # the router's affinity signal: fingerprints must round-trip
         # JSON (63-bit masked) and stay within the advertised budget
         summ = s["cache_summary"]
