@@ -938,6 +938,140 @@ def decode_window_bench(short_new=8, long_new=104, prompt_len=32,
     }
 
 
+def speculative_decode_bench(short_new=8, long_new=104, prompt_len=32,
+                             n_slots=32, cache_len=256, spec_k=4,
+                             reps=3):
+    """Speculative-decoding phase: B=32 continuous decode through K=4
+    draft/verify windows vs the plain K=1 loop on the SAME target
+    weights.
+
+    The model pair pins the acceptance rate at ~1.0 BY CONSTRUCTION so
+    the phase measures verify-window amortization, not model-pair
+    agreement luck: the target is the ``tiny`` preset with BOTH layers'
+    o_proj and down_proj zeroed (each layer then adds exactly zero to
+    the residual stream while keeping its shapes and FLOPs, so the
+    ``decode_tokens_per_sec_b32_k1`` baseline from the window phase
+    above stays like-for-like), which collapses the target's function
+    to embed -> norm -> lm_head of the last token; the draft is the
+    0-layer model SHARING exactly those leaves — a bigram draft in the
+    prompt-lookup/n-gram family, the cheap end of the draft spectrum —
+    so draft and target logits are identical and every greedy draft
+    token matches the target draw it guesses. Any acceptance below 1.0
+    here is dense-vs-paged attention numerics, which is exactly the
+    drift the parity tests bound.
+
+    Figures chain-difference a long and a short run of the same batch
+    (decode_window_bench's trick — prefill, admission stagger, and
+    ramp cancel); the dispatch ratio brackets the verify/decode records
+    with StepProfiler seq cursors; acceptance and rollback fractions
+    read the scheduler's cumulative counters over the whole phase.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+
+    cfg = PRESETS["tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    for layer in params["layers"]:
+        for name in ("o_proj", "down_proj"):
+            layer[name] = jnp.zeros_like(layer[name])
+    dcfg = dataclasses.replace(cfg, num_hidden_layers=0)
+    dparams = {
+        "embed_tokens": params["embed_tokens"],
+        "layers": [],
+        "norm": params["norm"],
+        "lm_head": params["lm_head"],
+    }
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_slots)
+    ]
+    steps = n_slots * (long_new - short_new)
+
+    def _phase(spec):
+        kw = (
+            {"spec_draft": (dparams, dcfg), "spec_k": spec_k}
+            if spec else {}
+        )
+        eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            max_window=1, **kw,
+        ).start()
+        try:
+            def _run(max_new):
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts
+                ]
+                for r in reqs:
+                    if not r.done.wait(timeout=300):
+                        raise TimeoutError("speculative-phase request hung")
+                return time.perf_counter() - t0
+
+            def _cursor():
+                prof = eng.profiler.snapshot()
+                return prof[-1].seq if prof else -1
+
+            def _dispatches(since, upto=None):
+                return len([
+                    r for r in eng.profiler.snapshot(since_seq=since)
+                    if r.phase in ("verify", "decode")
+                    and (upto is None or r.seq <= upto)
+                ])
+
+            _run(short_new)  # compile every shape on the path
+            _run(long_new)
+            _touch_progress()
+            shorts, longs = [], []
+            for _ in range(reps):
+                shorts.append(_run(short_new))
+                longs.append(_run(long_new))
+                _touch_progress()
+            c1 = _cursor()
+            _run(short_new)
+            c2 = _cursor()
+            _run(long_new)
+            d_s = _dispatches(c1, upto=c2)
+            d_l = _dispatches(c2)
+            dt = max(
+                statistics.median(longs) - statistics.median(shorts),
+                1e-9,
+            )
+            stats = eng.scheduler_stats()
+        finally:
+            eng.stop()
+        # per-ROW-token basis, matching decode_dispatches_per_token
+        # above (a K-window emits K tokens per row per dispatch →
+        # 1/K; a fully-accepted verify emits spec_k+1 → 1/(K+1))
+        return steps / dt, (d_l - d_s) / (long_new - short_new), stats
+
+    tps_spec, ratio_spec, stats = _phase(True)
+    tps_plain, _, _ = _phase(False)
+    drafted = stats["spec_draft_tokens"]
+    accepted = stats["spec_accepted_tokens"]
+    # spec_rollbacks counts per-row window boundaries that rejected a
+    # draft; drafted/spec_k is the number of row-windows, so the frac
+    # is "of the row-advances taken, how many rolled something back"
+    row_windows = max(drafted // spec_k, 1)
+    return {
+        "decode_tokens_per_sec_b32_spec": round(tps_spec, 1),
+        "spec_acceptance_rate": round(accepted / max(drafted, 1), 4),
+        "spec_rollback_frac": round(
+            stats["spec_rollbacks"] / row_windows, 4
+        ),
+        "spec_decode_speedup": round(
+            tps_spec / max(tps_plain, 1e-9), 3
+        ),
+        "spec_dispatches_per_token": round(ratio_spec, 4),
+    }
+
+
 def _sharded_serving_child_main() -> int:
     """Child body of :func:`sharded_serving_bench` — runs in its OWN
     process because the jax device count is fixed at backend init: once
@@ -1671,6 +1805,22 @@ def main() -> None:
                 extras[key] = dw[key]
         except Exception as e:
             extras["decode_window_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # speculative-decoding phase (paged verify-window PR): K=4
+        # draft/verify windows vs the plain K=1 loop at B=32 on an
+        # acceptance-~1.0-by-construction model pair (the k1 baseline
+        # above is FLOP-identical by the zeroed-layer trick), plus the
+        # acceptance/rollback evidence from the scheduler counters
+        try:
+            sp = speculative_decode_bench()
+            for key in (
+                "decode_tokens_per_sec_b32_spec",
+                "spec_acceptance_rate", "spec_rollback_frac",
+                "spec_decode_speedup", "spec_dispatches_per_token",
+            ):
+                extras[key] = sp[key]
+        except Exception as e:
+            extras["speculative_decode_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
         # fleet-routing phase (prefix-cache-aware router PR): p50 TTFT
         # through the summary-scoring router vs cache-blind round-robin

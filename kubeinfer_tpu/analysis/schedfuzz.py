@@ -682,6 +682,154 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_spec_rollback(fz: SchedFuzzer):
+    """Accept/rollback drain of the speculative verify window racing
+    staged admission, a preemption park, and the stop sweep
+    (batching._loop's verify branch against _plan_admissions,
+    _park_slot, and _fail_inflight).
+
+    The window boundary is where the device's data-dependent
+    acceptance (1..K+1 tokens per row) meets the host's budget: the
+    drain emits ``min(n_dev, budget_left)`` and — the invariant the
+    whole rollback design hangs on — any truncation COINCIDES with
+    retirement, so a live row's host progress always equals its
+    device offset and discarded device state is never resumed. A
+    parker moves a live row back to the queue mid-run (blocks
+    released, progress rides the request), and stop() sweeps staged,
+    pending, and live rows alike. Under EVERY schedule: pool refs
+    balance to zero, a live row's offset never exceeds its committed
+    count (and a retiring row's overshoot is bounded by the K-token
+    window tail), and each request reaches exactly one terminal
+    state. A schedule that drains a parked row double-serves; one
+    that loses a live row at stop leaks its verify-slack blocks.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool
+
+    K = 4
+    BUDGET = 6
+    pool = BlockPool(32, 4)
+    lock = make_lock("schedfuzz.engine-spec-rollback._lock")
+    pending: list[int] = []
+    staged: list[tuple[int, list[int]]] = []
+    slots: dict[int, dict] = {}
+    served: list[int] = []
+    failed: list[int] = []
+    state = {"stopped": False, "seq": 0}
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def scheduler() -> None:
+        for _ in range(12):
+            # overlap phase: the verify dispatch is notionally in
+            # flight; plan an admission host-side (the alloc carries
+            # the +K verify slack — modeled inside the same 2 blocks)
+            with lock:
+                if state["stopped"]:
+                    return
+                if pending:
+                    staged.append((pending.pop(0), pool.alloc(2)))
+            # window boundary: finalize staged admissions, then drain
+            # the accept/rollback results for every live row
+            with lock:
+                if state["stopped"]:
+                    return
+                for rid, blocks in staged:
+                    slots[rid] = {
+                        "blocks": blocks, "committed": 0, "offset": 0,
+                    }
+                staged.clear()
+                drain = []
+                for rid, row in list(slots.items()):
+                    # modeled device acceptance: 1..K+1 tokens, varied
+                    # by a Weyl sequence so the schedule (not the
+                    # code) decides which rows roll back vs fully
+                    # accept; n_dev < K+1 IS a rollback — the slack
+                    # blocks stay referenced, only the offset law
+                    # changes
+                    state["seq"] += 1
+                    n_dev = 1 + (state["seq"] * 2654435761) % (K + 1)
+                    row["offset"] += n_dev
+                    n_host = min(n_dev, BUDGET - row["committed"])
+                    row["committed"] += n_host
+                    if row["committed"] >= BUDGET:
+                        drain.append((rid, row["blocks"]))
+                        del slots[rid]
+                    else:
+                        # truncation coincides with retirement: a row
+                        # that emitted fewer tokens than the device
+                        # accepted must never stay live
+                        assert n_host == n_dev, (rid, n_host, n_dev)
+            # unref outside the lock (engine->pool order, like the
+            # production retire path)
+            for rid, blocks in drain:
+                pool.unref(blocks)
+                with lock:
+                    served.append(rid)
+
+    def parker() -> None:
+        for _ in range(3):
+            rid = None
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    rid = next(iter(slots))
+                    blocks = slots.pop(rid)["blocks"]
+            if rid is None:
+                continue
+            pool.unref(blocks)
+            with lock:
+                # warm readmit: progress rides the request, never the
+                # slot — a post-stop park routes to failed like any
+                # other post-stop submit
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def stopper() -> None:
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            swept = staged[:]
+            staged.clear()
+            leftover = pending[:]
+            pending.clear()
+            # live rows sweep too: their verify-slack blocks are the
+            # ones a lost row would leak
+            live = [(rid, row["blocks"]) for rid, row in slots.items()]
+            slots.clear()
+        for rid, blocks in swept + live:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("sched", scheduler)
+    fz.spawn("park", parker)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not staged and not pending and not slots, (
+            staged, pending, slots,
+        )
+        assert sorted(served + failed) == list(range(6)), (served, failed)
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -693,6 +841,7 @@ SCENARIOS = [
     Scenario("registry-scrape", _scn_registry_scrape),
     Scenario("engine-multistep", _scn_engine_multistep),
     Scenario("engine-sharded-window", _scn_engine_sharded_window),
+    Scenario("engine-spec-rollback", _scn_engine_spec_rollback),
 ]
 
 

@@ -60,11 +60,14 @@ from kubeinfer_tpu.observability.slo import SLOMonitor, SLOObjective
 from kubeinfer_tpu.observability.stepprof import StepProfiler
 from kubeinfer_tpu.inference.sharding import EngineLayout
 from kubeinfer_tpu.inference.stepper import (
+    DraftState,
     SlotState,
     WINDOW_BUCKETS,
     decode_window,
+    init_draft_state,
     init_slot_state,
     sample_rows,
+    verify_window,
 )
 
 log = logging.getLogger(__name__)
@@ -246,6 +249,69 @@ def _prefill_chunk(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("dcfg",), donate_argnums=(1,))
+def _admit_draft(
+    dparams: Params,
+    dstate: DraftState,
+    window: jax.Array,  # i32[1, T_bucket] FULL effective prompt, padded
+    prompt_len: jax.Array,  # i32[] live tokens in ``window``
+    dcfg: ModelConfig,
+    slot: jax.Array,  # i32[]
+) -> DraftState:
+    """Prefill the DRAFT model over one slot's effective prompt and
+    install the row (compiled per full-prompt bucket — the draft has no
+    radix reuse, so unlike ``_admit_slot`` the whole prompt recomputes;
+    the draft is small enough that this never dominates an admit).
+
+    The forward runs against throwaway 1-row caches and the result is
+    scattered into the slot's stripe of the dense draft cache. Padded
+    tail positions (>= prompt_len) carry junk KV, which is safe by the
+    DraftState invariant: verify_window's repair forward rewrites
+    positions offset-1 and offset before any read, and the propose scan
+    writes each deeper position before attending it — junk is never
+    upstream of a kept token. ``prev`` is the prompt's last token
+    (position prompt_len - 1): the target's ``last_token`` after admit
+    is the freshly sampled token at position prompt_len, one past it.
+
+    A 0-layer (bigram) draft — embed/norm/lm_head only, the degenerate
+    end of the draft spectrum, cf. prompt-lookup/n-gram drafting — has
+    no KV to prefill: its logits depend only on the previous token, so
+    installing the row is just setting ``prev``."""
+    T = window.shape[1]
+    if dcfg.num_hidden_layers == 0:
+        return dataclasses.replace(
+            dstate,
+            prev=dstate.prev.at[slot].set(window[0, prompt_len - 1]),
+        )
+    n_kv = dstate.caches_k[0].shape[2]
+    D = dstate.caches_k[0].shape[3]
+    caches = [
+        (
+            jnp.zeros((1, T, n_kv, D), dstate.caches_k[0].dtype),
+            jnp.zeros((1, T, n_kv, D), dstate.caches_v[0].dtype),
+        )
+        for _ in range(dcfg.num_hidden_layers)
+    ]
+    pos = jnp.arange(T)
+    mask = (pos[None, None, :] <= pos[None, :, None])
+    _, caches = forward(
+        dparams, window, dcfg, attn_mask=mask,
+        kv_caches=caches, cache_offset=0, return_hidden=True,
+    )
+
+    def put(pool, view):
+        return jax.lax.dynamic_update_slice(
+            pool, view, (slot, 0, 0, 0)
+        )
+
+    return dataclasses.replace(
+        dstate,
+        caches_k=[put(b, c[0]) for b, c in zip(dstate.caches_k, caches)],
+        caches_v=[put(b, c[1]) for b, c in zip(dstate.caches_v, caches)],
+        prev=dstate.prev.at[slot].set(window[0, prompt_len - 1]),
+    )
+
+
 # --- host-side scheduler ---------------------------------------------------
 
 
@@ -328,6 +394,11 @@ class _Request:
     # mistake the evenly spaced events for per-step measurements
     # (docs/OBSERVABILITY.md)
     interpolated: bool = False
+    # per-request speculative accounting (verify-window path): accepted
+    # draft tokens and windows that rolled at least one draft back —
+    # carried onto the engine.decode span at retirement
+    spec_accepted: int = 0
+    spec_rollbacks: int = 0
 
     @property
     def pending_since(self) -> float:
@@ -359,6 +430,9 @@ class _PrefillTask:
     pos: int
     tokens: list[int]
     resumed: bool
+    # the plan reserved verify slack (spec_k extra positions), so the
+    # finalize also prefills the slot's draft-cache row
+    spec_ok: bool = False
 
 
 class ContinuousEngine:
@@ -386,7 +460,9 @@ class ContinuousEngine:
                  prefill_chunk_blocks: int = 0,
                  preemption: PreemptionPolicy | None = None,
                  max_window: int = 8,
-                 layout: EngineLayout | None = None) -> None:
+                 layout: EngineLayout | None = None,
+                 spec_draft: tuple[Params, ModelConfig] | None = None,
+                 spec_k: int = 4) -> None:
         # device layout (sharding.EngineLayout): tp=1 (the default) is
         # meshless and every placement below is the identity — the
         # engine is byte-for-byte the single-device engine. Under tp>1
@@ -519,6 +595,64 @@ class ContinuousEngine:
         # preemption interleaves parked readmits with fresh arrivals,
         # so two unplaced requests can be in hand at once.
         self._holdover: "collections.deque[_Request]" = collections.deque()
+        # Speculative VERIFY path (distinct from the draft-GROUP path
+        # above — this one rides the paged batch itself): a draft model
+        # proposes spec_k tokens per live row and ONE fused
+        # stepper.verify_window dispatch scores/accepts them. When set,
+        # it supersedes the group route entirely (_place gates on it):
+        # the verify window serves every slot request, warm or resumed,
+        # with or without repetition penalty, and composes with
+        # preemption and tensor parallelism — everything the
+        # solo-dense group path cannot.
+        self.spec_draft = spec_draft
+        self.spec_k = spec_k
+        self._dparams: Params | None = None
+        self._dcfg: ModelConfig | None = None
+        self._dstate: DraftState | None = None
+        # per-slot: the admit plan reserved verify slack and the draft
+        # row was prefilled. Verify dispatches only when ALL live
+        # decoding rows are spec-capable (one fused window covers every
+        # slot); a single tight-on-cache row degrades the pass to
+        # decode_window, never to wrong output.
+        self._slot_spec_ok = [False] * n_slots
+        # monotonic verify-path counters (scheduler_stats -> /metrics
+        # delta): proposed draft tokens, host-accepted draft tokens,
+        # windows that rolled at least one draft back
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rollbacks = 0
+        if spec_draft is not None:
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            dparams, dcfg = spec_draft
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft/target vocabulary mismatch: "
+                    f"{dcfg.vocab_size} vs {cfg.vocab_size}"
+                )
+            if spec_k + 1 > cache_len:
+                raise ValueError(
+                    f"spec_k {spec_k} leaves no room in cache_len "
+                    f"{cache_len}"
+                )
+            # draft params/state replicate under tp: the draft is tiny,
+            # and replication keeps it free of head-divisibility
+            # constraints the target's Megatron specs impose
+            if self._sharded:
+                rep = self.layout.replicated()
+                dparams = jax.tree.map(
+                    lambda x: jax.device_put(x, rep), dparams
+                )
+            self._dparams, self._dcfg = dparams, dcfg
+            dstate = init_draft_state(
+                dcfg, n_slots, cache_len, params["norm"].dtype
+            )
+            if self._sharded:
+                rep = self.layout.replicated()
+                dstate = jax.tree.map(
+                    lambda x: jax.device_put(x, rep), dstate
+                )
+            self._dstate = dstate
         self._state = self.layout.shard_state(init_slot_state(
             cfg, n_slots, cache_len, params["norm"].dtype,
             num_blocks, self.block_size,
@@ -639,6 +773,12 @@ class ContinuousEngine:
             "parked": len(self._parked),
             # fused decode dispatches (each covers 1..max_window steps)
             "windows": self.windows_total,
+            # verify-window accounting (speculative decode on the paged
+            # batch): proposed / host-accepted draft tokens and windows
+            # that rolled at least one draft back
+            "spec_draft_tokens": self.spec_draft_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_rollbacks": self.spec_rollbacks,
         }
 
     def _note(self, kind: str, **detail) -> None:
@@ -798,7 +938,7 @@ class ContinuousEngine:
             req.done.set()
             failed += 1
         for req, _slot, kv_plan, _tokens in staged:
-            table_row, _own, _reuse, total = kv_plan
+            table_row, _own, _reuse, total, _spec = kv_plan
             self._pool.unref([int(b) for b in table_row[:total]])
             req.failed = "engine stopped before the request was served"
             req.done.set()
@@ -831,11 +971,20 @@ class ContinuousEngine:
         trie, so the match below recovers them with zero recompute) —
         and ``max_new`` the REMAINING budget, so the block horizon is
         identical across preemptions. Returns ``(table_row, own_mask,
-        reuse, total)`` — the static-shape operands ``_admit_slot``
-        needs — or None when the pool cannot supply the fresh blocks
+        reuse, total, spec_ok)`` — the static-shape operands
+        ``_admit_slot`` needs plus whether verify slack was reserved —
+        or None when the pool cannot supply the fresh blocks
         (admission backpressure; unreachable with the __init__ sizing
         floor but kept for custom pools). On success the slot holds one
-        reference per block in ``table_row[:total]``."""
+        reference per block in ``table_row[:total]``.
+
+        Verify slack: a verify window scatters KV up to position
+        ``offset + spec_k``, past the plain decode horizon, so a
+        spec-capable slot holds ceil((p + max_new + spec_k) / bs)
+        blocks. The slack is best-effort — under pool pressure the plan
+        falls back to the plain horizon with ``spec_ok=False`` and the
+        slot simply decodes through decode_window (degraded throughput,
+        never degraded correctness)."""
         p = len(tokens)
         bs = self.block_size
         matched = self._radix.match(tokens)  # +1 ref each, ours now
@@ -853,21 +1002,33 @@ class ContinuousEngine:
         if reuse < len(matched):
             self._pool.unref(matched[reuse:])
         shared = matched[:reuse]
-        total = -(-(p + max_new) // bs)  # ceil; fits() bounds it
+        plain = -(-(p + max_new) // bs)  # ceil; fits() bounds it
+        spec_ok = (
+            self.spec_draft is not None
+            and p + max_new + self.spec_k <= self.cache_len
+        )
+        total = -(-(p + max_new + self.spec_k) // bs) if spec_ok else plain
         ev_before = self._radix.stats()["evictions"]
         if not self._radix.ensure_free(total - reuse):
-            if shared:
-                self._pool.unref(shared)
-            # the fail-fast precheck (kv_blocks.ensure_free) means this
-            # fires WITHOUT stripping the trie when the shortfall is
-            # structural; the detail says which case the post-mortem is
-            # looking at (free+evictable < need = pinned by live rows)
-            self._note("backpressure", prompt_tokens=p,
-                       need_blocks=total - reuse,
-                       free_blocks=self._pool.free_blocks,
-                       evictable_blocks=self._radix.evictable_blocks(),
-                       reason="pool pinned beyond eviction reach")
-            return None
+            # drop the verify slack first: a spec-capable plan must
+            # never fail an admission the plain plan could serve
+            if spec_ok and total > plain and \
+                    self._radix.ensure_free(plain - reuse):
+                total, spec_ok = plain, False
+            else:
+                if shared:
+                    self._pool.unref(shared)
+                # the fail-fast precheck (kv_blocks.ensure_free) means
+                # this fires WITHOUT stripping the trie when the
+                # shortfall is structural; the detail says which case
+                # the post-mortem is looking at (free+evictable < need
+                # = pinned by live rows)
+                self._note("backpressure", prompt_tokens=p,
+                           need_blocks=total - reuse,
+                           free_blocks=self._pool.free_blocks,
+                           evictable_blocks=self._radix.evictable_blocks(),
+                           reason="pool pinned beyond eviction reach")
+                return None
         evicted = self._radix.stats()["evictions"] - ev_before
         if evicted:
             self._note("evict", nodes=evicted, need_blocks=total - reuse)
@@ -878,7 +1039,7 @@ class ContinuousEngine:
         table_row[reuse:total] = fresh
         own_mask = np.zeros(self.max_blocks, bool)
         own_mask[reuse:total] = True
-        return table_row, own_mask, reuse, total
+        return table_row, own_mask, reuse, total, spec_ok
 
     def _admit(self, slot: int, req: _Request, kv_plan,
                tokens: list[int]) -> None:
@@ -888,7 +1049,7 @@ class ContinuousEngine:
         decode steps interleave; otherwise (short suffix, chunking off)
         the whole suffix goes through ``_finalize_admit`` in one
         dispatch, exactly the pre-chunking admit."""
-        table_row, own_mask, reuse, total = kv_plan
+        table_row, own_mask, reuse, total, spec_ok = kv_plan
         resumed = bool(req.out_tokens)
         if not resumed:
             # first admission only: a readmit is not a queue exit (the
@@ -906,11 +1067,15 @@ class ContinuousEngine:
                 )
         self._slot_req[slot] = req
         self._slot_blocks[slot] = [int(b) for b in table_row[:total]]
+        # the flag flips TRUE only when _finalize_admit also committed
+        # the draft row; until then the slot is mid-prefill (inactive)
+        # and never counted by the verify gate anyway
+        self._slot_spec_ok[slot] = False
         req.tokens_at_admit = len(req.out_tokens)
         task = _PrefillTask(
             req=req, slot=slot, table_row=table_row, own_mask=own_mask,
             reuse=reuse, total=total, pos=reuse * self.block_size,
-            tokens=tokens, resumed=resumed,
+            tokens=tokens, resumed=resumed, spec_ok=spec_ok,
         )
         if self._next_chunk_len(task) is not None:
             self._prefills.append(task)
@@ -991,6 +1156,7 @@ class ContinuousEngine:
         cleanup; no device state to touch."""
         slot, req = task.slot, task.req
         self._slot_req[slot] = None
+        self._slot_spec_ok[slot] = False
         blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
         if blocks:
             self._pool.unref(blocks)
@@ -1037,6 +1203,22 @@ class ContinuousEngine:
             jnp.float32(req.top_p), jnp.float32(req.rep_penalty), key_data,
             jnp.asarray(seen_row),
         )
+        if self.spec_draft is not None and task.spec_ok:
+            # draft-row prefill rides the same boundary: the draft has
+            # no radix reuse (and no chunking — it is small enough not
+            # to need either), so the FULL effective prompt recomputes
+            # in one dispatch, compiled per full-prompt bucket. The
+            # bucket fits by the same guards that admitted the target
+            # (submit's fits() for fresh prompts, _pick_victim's bucket
+            # check for readmits).
+            Td = _bucket(p)
+            dwin = np.zeros((1, Td), np.int32)
+            dwin[0, :p] = tokens
+            self._dstate = _admit_draft(
+                self._dparams, self._dstate, jnp.asarray(dwin),
+                jnp.int32(p), self._dcfg, jnp.int32(slot),
+            )
+            self._slot_spec_ok[slot] = True
         # cache the effective prompt's FULL blocks for later admits —
         # including this one's fresh blocks (their KV is committed by
         # the scatter above; the partial tail block stays private)
@@ -1102,6 +1284,7 @@ class ContinuousEngine:
         )
         if finished:
             self._slot_req[slot] = None
+            self._slot_spec_ok[slot] = False
             blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
             if blocks:
                 # drop the slot's hold; blocks also cached in the trie
@@ -1131,6 +1314,9 @@ class ContinuousEngine:
                 # measurements (docs/OBSERVABILITY.md, TPOT row)
                 **({"kubeinfer.interpolated": True}
                    if req.interpolated else {}),
+                **({"kubeinfer.spec_accepted": req.spec_accepted,
+                    "kubeinfer.spec_rollbacks": req.spec_rollbacks}
+                   if self.spec_draft is not None else {}),
             )
             for i, ts in enumerate(req.token_times[:_MAX_TOKEN_EVENTS]):
                 sp.event("token", ts=ts, i=i)
@@ -1168,6 +1354,7 @@ class ContinuousEngine:
         if full:
             self._radix.insert(committed, blocks[:full])
         self._slot_req[slot] = None
+        self._slot_spec_ok[slot] = False
         if blocks:
             self._pool.unref(blocks)
         self._state = dataclasses.replace(
@@ -1417,6 +1604,11 @@ class ContinuousEngine:
             group_free = self._spec_group is None
         if (
             self.speculative is not None
+            # paged verify windows supersede the dense side-car: when a
+            # draft model is wired into the batch itself, every request
+            # should ride the paged path (the group would steal exactly
+            # the prompts that speculate best)
+            and self.spec_draft is None
             and group_free
             and not resumed
             and req.rep_penalty == 1.0
@@ -1488,7 +1680,7 @@ class ContinuousEngine:
                     # reachable through a future scheduler change —
                     # this thread is the sole admitter) sends the
                     # request back to the head of the line
-                    table_row, _own, _reuse, total = kv_plan
+                    table_row, _own, _reuse, total, _spec = kv_plan
                     self._pool.unref(
                         [int(b) for b in table_row[:total]]
                     )
@@ -1540,6 +1732,7 @@ class ContinuousEngine:
                 group_free = self._spec_group is None
             if (
                 self.speculative is not None
+                and self.spec_draft is None
                 and group_free
                 and not resumed
                 and req.rep_penalty == 1.0
@@ -1621,6 +1814,19 @@ class ContinuousEngine:
                     if r is not None and s not in prefilling
                 ]
                 decode_rows = len(budgets)
+                # verify windows are all-or-nothing: a live row whose
+                # admit fell back to the plain block budget (_plan_kv
+                # spec_ok=False) has no +spec_k slack, and the fused
+                # dispatch cannot exclude single rows — so any such row
+                # drops the whole batch to plain decode until it
+                # retires or parks
+                spec_ready = self.spec_draft is not None and bool(
+                    budgets
+                ) and all(
+                    self._slot_spec_ok[s]
+                    for s, r in enumerate(self._slot_req)
+                    if r is not None and s not in prefilling
+                )
                 host_work = (
                     bool(self._holdover) or bool(self._parked)
                     or bool(self._prefills)
@@ -1635,7 +1841,82 @@ class ContinuousEngine:
             # of K=1 or one window of delayed admission — never
             # correctness
             host_work = host_work or not self._queue.empty()
-            if decode_rows:
+            if decode_rows and spec_ready:
+                # the speculative twin of the fused branch below: one
+                # verify dispatch advances every row by 1..spec_k+1
+                # tokens (data-dependent, unlike the fixed-K window),
+                # and the boundary drain is where accept/rollback meets
+                # the scheduler — truncation below always coincides
+                # with retirement, so discarded device progress never
+                # leaks into a continuing row
+                step_t0 = tracing.now()
+                # lint: allow[lock-discipline] scheduler thread is the only _state writer; see comment above
+                self._state, self._dstate, tokens = verify_window(
+                    self.params, self._state, self._dparams,
+                    self._dstate, self.cfg, self._dcfg, self.spec_k,
+                    sharded=self._sharded,
+                )
+                self._plan_admissions()
+                # lint: allow[host-sync] window boundary: the [n_slots, spec_k+1] token matrix feeds the Python result queues
+                toks = np.asarray(tokens)
+                step_t = tracing.now()
+                self.windows_total += 1
+                self._steps_since_preempt += self.spec_k
+                accepted = 0
+                with self._lock:
+                    for slot in range(self.n_slots):
+                        req = self._slot_req[slot]
+                        row = toks[slot]
+                        n_dev = int((row >= 0).sum())
+                        if req is None or n_dev == 0:
+                            continue
+                        self.spec_draft_tokens += self.spec_k
+                        # device acceptance may overshoot the request
+                        # budget or run past EOS (the window cannot
+                        # stop mid-dispatch); the host emits the
+                        # truncated prefix and every truncation lands
+                        # on a retirement below, so the row's advanced
+                        # device state is discarded, never resumed —
+                        # that is what keeps truncation identity-safe
+                        n_host = min(n_dev, req.max_new
+                                     - len(req.out_tokens))
+                        if req.eos_id >= 0:
+                            for i in range(n_host):
+                                if int(row[i]) == req.eos_id:
+                                    n_host = i + 1
+                                    break
+                        for j in range(n_host):
+                            t_j = step_t0 + (j + 1) * (
+                                step_t - step_t0) / n_host
+                            req.out_tokens.append(int(row[j]))
+                            req.token_times.append(t_j)
+                        if n_host > 1:
+                            req.interpolated = True
+                        accepted += n_host
+                        # n_dev = accepted drafts + the bonus token
+                        # the verify forward samples past the last
+                        # accepted draft, so drafts-accepted is n_dev-1
+                        acc_d = n_dev - 1
+                        self.spec_accepted_tokens += acc_d
+                        req.spec_accepted += acc_d
+                        if acc_d < self.spec_k:
+                            self.spec_rollbacks += 1
+                            req.spec_rollbacks += 1
+                        self._maybe_retire(slot)
+                # ONE record per verify dispatch, phase "verify" so the
+                # decode-dispatches-per-token summary and the compile
+                # proxy (first-seen phase/bucket) stay honest about
+                # which compiled shape ran; bucket is spec_k (one
+                # compiled verify shape per K)
+                self.profiler.record(
+                    "verify", bucket=self.spec_k,
+                    live_rows=decode_rows, live_tokens=accepted,
+                    padded_tokens=(
+                        self.n_slots * (self.spec_k + 1) - accepted
+                    ),
+                    start=step_t0, end=step_t, steps=self.spec_k,
+                )
+            elif decode_rows:
                 k = self._pick_horizon(budgets, host_work)
                 # device window outside the lock (it can block on a
                 # compile; stop() must still be able to fail the slots)

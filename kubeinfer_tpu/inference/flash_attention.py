@@ -642,27 +642,43 @@ def decode_attention_auto(q, k, v, lengths, mask, gspmd=False):
 def _decode_blocks_kernel(
     tbl_ref,  # scalar-prefetch i32[B, max_blocks]: per-row block tables
     len_ref,  # scalar-prefetch i32[B]: per-row live lengths
-    q_ref,  # [1, G, D] — the row's single query, groups as MXU rows
+    q_ref,  # [1, T*G, D] — the row's T queries, (t, group) as MXU rows
     k_ref,  # [1, 1, block_size, D] — one pool block for one kv head
     v_ref,  # [1, 1, block_size, D]
-    o_ref,  # [1, G, D] out
-    m_scr,  # f32[G, 1]
-    l_scr,  # f32[G, 1]
-    acc_scr,  # f32[G, D]
+    o_ref,  # [1, T*G, D] out
+    m_scr,  # f32[T*G, 1]
+    l_scr,  # f32[T*G, 1]
+    acc_scr,  # f32[T*G, D]
     *,
     groups: int,
     scale: float,
     n_blocks: int,
     block_size: int,
     n_kv: int,
+    n_q: int,
 ):
     """_decode_attn_kernel over a paged cache: the grid's S axis walks
     the row's block table (resolved in the index_map — tbl_ref is unused
     here) and the penalty is derived from the LOGICAL position
     ts * block_size + i, so the fold math is position-for-position the
     linear kernel's. Same bit-identical skip/clamp story: a tile past
-    the live length folds exactly 0, row_len == 0 rows stay dense over
-    whatever their (null-padded) table names."""
+    the live length folds exactly 0, rows shorter than the window stay
+    dense over whatever their (null-padded) table names.
+
+    ``n_q`` > 1 is the speculative verify window: query t of a row sits
+    at logical position row_len - n_q + t (the window's tokens are the
+    cache's LAST n_q positions, scattered by the caller before the
+    read), so the causal mask within the window is the only new math —
+    pen row t admits s_pos <= row_len - n_q + t, which at n_q == 1
+    reduces exactly to the decode rule s_pos < row_len. The live tile
+    set is unchanged (the last query attends precisely s_pos < row_len),
+    so the skip predicate needs no T term — EXCEPT rows with
+    row_len < n_q, whose leading queries are fully masked: their
+    uniform-over-junk output depends on every tile the twin folds, so
+    the dense fallback generalizes from row_len == 0 to row_len < n_q
+    (identical at n_q == 1; the engine never emits such rows, since a
+    verify dispatch sets lengths = offset + T, but the twin contract
+    must hold on the whole operand domain)."""
     del tbl_ref  # consumed by the BlockSpec index_map, not the body
     row_len = len_ref[pl.program_id(0) // n_kv]
     ts = pl.program_id(1)  # innermost: table walk with resident scratch
@@ -673,12 +689,15 @@ def _decode_blocks_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when((ts == 0) | (row_len == 0) | (ts * block_size < row_len))
+    @pl.when((ts == 0) | (row_len < n_q) | (ts * block_size < row_len))
     def _fold():
         s_pos = ts * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1
+            jnp.int32, (n_q, block_size), 1
         )
-        pen = jnp.where(s_pos < row_len, 0.0, -1e30)
+        q_pos = row_len - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, (n_q, block_size), 0
+        )
+        pen = jnp.where(s_pos <= q_pos, 0.0, -1e30)
         m_new, l_new, acc_new = _fold_tile_math(
             q_ref[0], k_ref[0, 0], v_ref[0, 0], pen,
             m_scr[:], l_scr[:], acc_scr[:],
@@ -696,11 +715,11 @@ def _decode_blocks_kernel(
 
 
 def decode_attention_blocks(
-    q: jax.Array,  # [B, 1, n_heads, D] — one new token per row
+    q: jax.Array,  # [B, T, n_heads, D] — the row's last T tokens
     k_pool: jax.Array,  # [num_blocks, block_size, n_kv, D] shared pool
     v_pool: jax.Array,  # [num_blocks, block_size, n_kv, D]
     block_tables: jax.Array,  # i32[B, max_blocks]: pool indices, seq order
-    lengths: jax.Array,  # i32[B]: live entries per row (offset + 1)
+    lengths: jax.Array,  # i32[B]: live entries per row (offset + T)
     *,
     interpret: bool = False,
 ) -> jax.Array:
@@ -710,38 +729,48 @@ def decode_attention_blocks(
     walk past each row's last live block (same DMA-elision contract as
     decode_attention) and then indirects through the table, so shared
     prefix blocks are fetched once per consecutive reuse rather than
-    duplicated per row. Twin: decode_attention_blocks_jnp
-    (bit-identical, parity-tested in tests/test_flash_attention.py)."""
+    duplicated per row. T > 1 is the speculative verify window: query t
+    attends cache positions <= lengths[b] - T + t (the window occupies
+    the row's last T live positions, already scattered into the pool by
+    the caller), folded causally inside the kernel's penalty — the T
+    axis rides the MXU row dim next to the GQA groups, so the tile walk
+    and DMA schedule are the T == 1 kernel's unchanged. Twin:
+    decode_attention_blocks_jnp (bit-identical, parity-tested in
+    tests/test_flash_attention.py)."""
     B, T, n_heads, D = q.shape
-    if T != 1:
-        raise ValueError(
-            f"decode_attention_blocks is T == 1 only; got T={T}"
-        )
     num_blocks, block_size, n_kv = k_pool.shape[:3]
     max_blocks = block_tables.shape[1]
     G = n_heads // n_kv
 
-    qf = q.reshape(B, n_kv, G, D).reshape(B * n_kv, G, D)
+    # (t, group) flatten with t OUTER: _fold_tile_math reshapes rows as
+    # (tq, groups, sk) + pen[:, None, :], so pen row t must cover the
+    # contiguous run of G MXU rows belonging to query t.
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B * n_kv, T * G, D
+    )
     # [num_blocks, n_kv, block_size, D]: one (block, head) pair per tile
     kp = k_pool.transpose(0, 2, 1, 3)
     vp = v_pool.transpose(0, 2, 1, 3)
     tbl = jnp.asarray(block_tables, jnp.int32)
     lens = jnp.asarray(lengths, jnp.int32)
 
-    def _kv_map(bh, ts, tbl_ref, lens_ref, n_kv=n_kv, bs=block_size):
+    def _kv_map(bh, ts, tbl_ref, lens_ref, n_kv=n_kv, bs=block_size,
+                nq=T):
         # Same clamp as decode_attention's _kv_map, then the table
         # lookup: dead steps re-name the row's last live block so
-        # Pallas elides their DMAs. row_len == 0 rows walk their true
-        # (null-padded) table — their defined output is the uniform
-        # average over what the table names, mirroring the twin.
+        # Pallas elides their DMAs. Rows shorter than the window
+        # (row_len < nq, the kernel's dense-fallback predicate) walk
+        # their true (null-padded) table — their defined output is the
+        # uniform average over what the table names, mirroring the
+        # twin, which a clamp would silently re-point at live data.
         b = bh // n_kv
         rl = lens_ref[b]
         live_last = jnp.maximum(rl - 1, 0) // bs
-        step = jnp.where(rl == 0, ts, jnp.minimum(ts, live_last))
+        step = jnp.where(rl < nq, ts, jnp.minimum(ts, live_last))
         return (tbl_ref[b, step], bh % n_kv, 0, 0)
 
     q_spec = pl.BlockSpec(
-        (1, G, D), lambda bh, ts, tbl_ref, lens_ref: (bh, 0, 0),
+        (1, T * G, D), lambda bh, ts, tbl_ref, lens_ref: (bh, 0, 0),
         memory_space=pltpu.VMEM,
     )
     kv_spec = pl.BlockSpec(
@@ -751,6 +780,7 @@ def decode_attention_blocks(
         functools.partial(
             _decode_blocks_kernel, groups=G, scale=1.0 / float(D) ** 0.5,
             n_blocks=max_blocks, block_size=block_size, n_kv=n_kv,
+            n_q=T,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -758,19 +788,21 @@ def decode_attention_blocks(
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=q_spec,
             scratch_shapes=[
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
+                pltpu.VMEM((T * G, 1), jnp.float32),
+                pltpu.VMEM((T * G, 1), jnp.float32),
+                pltpu.VMEM((T * G, D), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B * n_kv, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
         interpret=interpret,
     )(tbl, lens, qf, kp, vp)
-    return out.reshape(B, 1, n_heads, D)
+    return out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, n_heads, D
+    )
 
 
 def decode_attention_blocks_jnp(
-    q: jax.Array,  # [B, 1, n_heads, D]
+    q: jax.Array,  # [B, T, n_heads, D]
     k_pool: jax.Array,  # [num_blocks, block_size, n_kv, D]
     v_pool: jax.Array,
     block_tables: jax.Array,  # i32[B, max_blocks]
@@ -780,22 +812,23 @@ def decode_attention_blocks_jnp(
     per-row with lax.map and per-block with lax.scan, gathering each
     tile through the row's table exactly as the kernel's index_map does
     (minus the clamp — dead tiles fold exactly 0 either way, see
-    decode_attention_jnp's note). Because a gathered block holds the
-    same values as the linear cache's corresponding tile, this twin is
-    also bitwise equal to decode_attention_jnp(tile_s=block_size) on
-    the gathered cache — parity-tested both ways."""
+    decode_attention_jnp's note). T > 1 mirrors the kernel's in-window
+    causal penalty (query t at logical position rl - T + t). Because a
+    gathered block holds the same values as the linear cache's
+    corresponding tile, this twin is also bitwise equal to
+    decode_attention_jnp(tile_s=block_size) on the gathered cache —
+    parity-tested both ways."""
     B, T, n_heads, D = q.shape
-    if T != 1:
-        raise ValueError(
-            f"decode_attention_blocks_jnp is T == 1 only; got T={T}"
-        )
     block_size, n_kv = k_pool.shape[1], k_pool.shape[2]
     max_blocks = block_tables.shape[1]
     G = n_heads // n_kv
     BH = B * n_kv
     scale = 1.0 / float(D) ** 0.5
 
-    qf = q.reshape(B, n_kv, G, D).reshape(BH, G, D)
+    # Same (t, group) row order as the kernel's qf flatten.
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        BH, T * G, D
+    )
     kp = k_pool.transpose(0, 2, 1, 3)  # [num_blocks, n_kv, bs, D]
     vp = v_pool.transpose(0, 2, 1, 3)
     tbl = jnp.asarray(block_tables, jnp.int32)
@@ -804,24 +837,27 @@ def decode_attention_blocks_jnp(
     row_len = jnp.repeat(jnp.asarray(lengths, jnp.int32), n_kv)  # [BH]
 
     def _row(args):
-        qr, trow, h, rl = args  # [G, D], i32[max_blocks], i32, i32
+        qr, trow, h, rl = args  # [T*G, D], i32[max_blocks], i32, i32
 
         def step(carry, ts):
             m, l, acc = carry
             k_t = kp[trow[ts], h]  # [bs, D] — the kernel's tile, gathered
             v_t = vp[trow[ts], h]
             s_pos = ts * block_size + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_size), 1
+                jnp.int32, (T, block_size), 1
             )
-            pen = jnp.where(s_pos < rl, 0.0, -1e30)
+            q_pos = rl - T + jax.lax.broadcasted_iota(
+                jnp.int32, (T, block_size), 0
+            )
+            pen = jnp.where(s_pos <= q_pos, 0.0, -1e30)
             return _fold_tile_math(
                 qr, k_t, v_t, pen, m, l, acc, groups=G, scale=scale
             ), None
 
         init = (
-            jnp.full((G, 1), -1e30, jnp.float32),
-            jnp.zeros((G, 1), jnp.float32),
-            jnp.zeros((G, D), jnp.float32),
+            jnp.full((T * G, 1), -1e30, jnp.float32),
+            jnp.zeros((T * G, 1), jnp.float32),
+            jnp.zeros((T * G, D), jnp.float32),
         )
         (m, l, acc), _ = jax.lax.scan(
             step, init, jnp.arange(max_blocks, dtype=jnp.int32)
@@ -829,7 +865,9 @@ def decode_attention_blocks_jnp(
         return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
     out = jax.lax.map(_row, (qf, row_tbl, row_head, row_len))
-    return out.reshape(B, 1, n_heads, D)
+    return out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, n_heads, D
+    )
 
 
 def gather_block_kv(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
@@ -871,8 +909,14 @@ def decode_attention_blocks_auto(q, k_pool, v_pool, block_tables, lengths,
     own heads' slice of the named blocks through the same host i32
     tables, and the dense einsum partitions over heads — whereas the
     block kernel is a custom call GSPMD cannot split (see
-    decode_attention_auto)."""
-    if (not gspmd) and q.shape[1] == 1 and decode_blocks_available(
+    decode_attention_auto).
+
+    Any T >= 1 routes to the kernel: T > 1 is the speculative verify
+    window, whose in-window causal rule the kernel derives from
+    ``lengths`` alone — ``mask`` must equal that rule
+    (mask[b, t, s] = s <= lengths[b] - T + t) for the branches to
+    agree."""
+    if (not gspmd) and decode_blocks_available(
         k_pool.shape[1], q.shape[3]
     ):
         return decode_attention_blocks(

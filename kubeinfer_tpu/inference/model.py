@@ -260,11 +260,11 @@ def decoder_layer(
     if kv_cache is not None:
         ck, cv = kv_cache
         if block_tables is not None:
-            if T != 1 or getattr(cache_offset, "ndim", 0) != 1:
+            if getattr(cache_offset, "ndim", 0) != 1:
                 raise ValueError(
-                    "block_tables requires T == 1 decode with per-row "
-                    "cache_offset (prefill writes go through the "
-                    "engine's paged admit, not decoder_layer)"
+                    "block_tables requires per-row cache_offset "
+                    "(prefill writes go through the engine's paged "
+                    "admit, not decoder_layer)"
                 )
             # paged decode write: one batched scatter into the pool.
             # Rows of a retired slot carry an all-null table, so their
@@ -273,9 +273,21 @@ def decoder_layer(
             # which is fine because nothing ever attends to it.
             bs = ck.shape[1]
             rows = jnp.arange(block_tables.shape[0])
-            blk = block_tables[rows, cache_offset // bs]
-            ck = ck.at[blk, cache_offset % bs].set(k[:, 0])
-            cv = cv.at[blk, cache_offset % bs].set(v[:, 0])
+            if T == 1:
+                blk = block_tables[rows, cache_offset // bs]
+                ck = ck.at[blk, cache_offset % bs].set(k[:, 0])
+                cv = cv.at[blk, cache_offset % bs].set(v[:, 0])
+            else:
+                # speculative verify window: row b writes its T tokens
+                # at contiguous logical positions cache_offset[b] + t.
+                # Within a live row the (block, slot) pairs are
+                # distinct; cross-row collisions happen only on the
+                # null block 0 above, so scatter order never matters
+                # for anything attended to.
+                pos = cache_offset[:, None] + jnp.arange(T)
+                blk = block_tables[rows[:, None], pos // bs]
+                ck = ck.at[blk, pos % bs].set(k)
+                cv = cv.at[blk, pos % bs].set(v)
         elif getattr(cache_offset, "ndim", 0) == 1:
             # per-row offsets (continuous-batching / ragged decode:
             # rows at different sequence positions in one dispatch)

@@ -124,6 +124,35 @@ def _serving_metrics(registry: Registry):
             "Draft tokens accepted by the target across all groups",
             registry=registry,
         ),
+        # paged speculative decoding (batching.py verify windows, gated
+        # by --speculative-draft): the engine's monotonic ints convert
+        # to Prometheus counters by delta at scrape time under the kv
+        # lock, same discipline as the radix counters below; the ratio
+        # gauge is cumulative accepted/proposed so dashboards read the
+        # acceptance rate without a PromQL rate-quotient
+        "spec_draft_tokens": Counter(
+            "kubeinfer_spec_draft_tokens_total",
+            "Draft tokens proposed by paged verify windows",
+            registry=registry,
+        ),
+        "spec_accepted_tokens": Counter(
+            "kubeinfer_spec_accepted_tokens_total",
+            "Proposed draft tokens the target accepted at a window "
+            "boundary",
+            registry=registry,
+        ),
+        "spec_rollbacks": Counter(
+            "kubeinfer_spec_rollbacks_total",
+            "Verify windows that rejected at least one draft token "
+            "for some row",
+            registry=registry,
+        ),
+        "spec_acceptance_ratio": Gauge(
+            "kubeinfer_spec_acceptance_ratio",
+            "Cumulative accepted/proposed draft tokens (0 until the "
+            "first window)",
+            registry=registry,
+        ),
         # paged-KV pool + radix prefix cache (batching.kv_cache_stats):
         # gauges snapshot pool occupancy; the cache counters are
         # Prometheus counters fed by delta at scrape time so restarts
@@ -501,10 +530,20 @@ class InferenceServer:
                 ("preempted", "preemptions"),
                 ("resumed", "resumes"),
                 ("chunks", "chunks"),
+                ("spec_draft_tokens", "spec_draft_tokens"),
+                ("spec_accepted_tokens", "spec_accepted_tokens"),
+                ("spec_rollbacks", "spec_rollbacks"),
             ):
                 delta = sched[key] - self._kv_last.get(key, 0)
                 self.metrics[name].inc(by=delta)
                 self._kv_last[key] = sched[key]
+            # ratio from the cumulative ints, not the deltas: a scrape
+            # landing between windows would otherwise read 0/0 and
+            # flap the gauge to zero
+            self.metrics["spec_acceptance_ratio"].set(
+                sched["spec_accepted_tokens"]
+                / max(sched["spec_draft_tokens"], 1)
+            )
             # profiler replay under the same lock: the cursor advance
             # and the histogram observes must be atomic per scrape or a
             # concurrent scrape double-counts the same step records
@@ -812,6 +851,12 @@ def main(argv: list[str] | None = None) -> int:
                         "target's vocabulary")
     p.add_argument("--speculation-depth", type=int, default=4,
                    help="draft tokens proposed per verification round")
+    p.add_argument("--speculative-draft", action="store_true",
+                   help="run the --draft-model inside the continuous "
+                        "batcher's paged batch: K-query verify windows "
+                        "with accept/rollback at the window boundary "
+                        "(supersedes the dense draft-group side-car for "
+                        "slot-served requests; greedy and sampled alike)")
     p.add_argument("--prewarm-spec", default="",
                    help="comma-separated draft-group sizes to compile "
                         "before serving (e.g. '1,2,4'); without it the "
@@ -891,7 +936,13 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     engine = Engine(params, cfg, max_cache_len=max_cache)
+    if args.speculative_draft and not args.draft_model:
+        raise SystemExit(
+            "--speculative-draft requires --draft-model (the paged "
+            "verify windows run the same draft weights)"
+        )
     speculative = None
+    dparams = dcfg = None
     if args.draft_model:
         from kubeinfer_tpu.inference.speculative import SpeculativeEngine
 
@@ -944,6 +995,10 @@ def main(argv: list[str] | None = None) -> int:
             prefill_chunk_blocks=args.prefill_chunk_blocks,
             preemption=preemption,
             layout=layout,
+            spec_draft=(
+                (dparams, dcfg) if args.speculative_draft else None
+            ),
+            spec_k=args.speculation_depth,
         )
         if args.prewarm_spec and speculative is not None:
             sizes = tuple(

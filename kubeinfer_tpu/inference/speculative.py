@@ -1,12 +1,15 @@
 """Speculative decoding: a small draft model proposes, the target verifies.
 
-Two acceptance modes, selected by temperature:
+ONE acceptance rule, greedy and sampled alike: ``stepper.spec_accept``
+— accept draft token i iff it equals the token the TARGET itself draws
+at that position, under the position-folded noise schedule the decode
+stepper uses everywhere (``stepper.sample_rows``: a per-row key folded
+with the token's output index). The emitted row IS the target's own
+sample stream, so:
 
-- **Greedy** (temperature <= 0): accept a draft token iff it equals the
-  target's own argmax at that position. The output is **token-identical
-  to vanilla greedy decoding** for ANY draft model — the draft only
-  changes how many target forwards the sequence costs, never what it
-  says. That identity is the correctness contract
+- **Greedy** (temperature <= 0) output is **token-identical to vanilla
+  greedy decoding** for ANY draft model — the draft only changes how
+  many target forwards the sequence costs, never what it says
   (tests/test_speculative.py pins it against Engine.generate). Caveat
   (advisor r2): the identity additionally assumes the backend produces
   shape-independent matmul/softmax numerics — the verification forward
@@ -14,18 +17,21 @@ Two acceptance modes, selected by temperature:
   reassociate differently per shape, so a near-tied argmax could
   diverge on some backends even though the CPU tests pin it (same class
   of caveat as the flash-vs-dense note in engine.chunked_prefill).
-- **Sampled** (temperature > 0): the rejection-sampling correction from
-  the speculative-decoding literature (PAPERS.md). The draft SAMPLES
-  x_i ~ q_i from its own warped distribution (same temperature/top-k/
-  top-p warping as vanilla sampling); the target accepts x_i with
-  probability min(1, p_i(x_i)/q_i(x_i)); the first rejected position
-  resamples from the residual distribution norm(max(p_i - q_i, 0)), and
-  a fully-accepted round samples its bonus token from p_{k+1}. This
-  yields EXACTLY the target's sampling distribution — not an
-  approximation — for any draft (tests pin the distributional match
-  against vanilla Engine sampling). Repetition penalty stays excluded
-  (it reshapes p per step from generated-token state the verifier's
-  parallel window cannot see; the server routes such requests away).
+- **Sampled** (temperature > 0) output follows EXACTLY the target's
+  sampling law — not an approximation — for any draft, because every
+  emitted token is the target's own draw under fresh per-position
+  noise; the draft only decides how many of those draws one verify
+  forward can confirm. The draft proposes with the SAME key/counter as
+  the target draw it is guessing, so a perfect draft proposes the
+  identical token and acceptance is ~1.0 (correlated noise moves the
+  acceptance RATE, never the output distribution). This replaces the
+  earlier rejection-sampling correction: match-acceptance needs no
+  residual resample, keeps one acceptance implementation for this
+  engine and the paged verify window (stepper.verify_window), and is
+  what makes the paged twin token-identical to its plain engine.
+  Repetition penalty stays excluded (it reshapes the distribution per
+  step from generated-token state the verifier's parallel window
+  cannot see; the server routes such requests away).
 
 Static shapes throughout (the jit discipline of engine.py):
 
@@ -67,34 +73,28 @@ from kubeinfer_tpu.inference.engine import (
     GenerationResult,
     chunked_prefill,
     prefill_chunk_for,
-    filter_logits,
-    gumbel_pick,
     make_caches,
     prepare_prompts,
 )
 from kubeinfer_tpu.inference.model import Params, forward
+from kubeinfer_tpu.inference.stepper import sample_rows, spec_accept
 
 
-def _greedy(logits: jax.Array) -> jax.Array:
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-
-def _warped_dist(logits, temperature, top_k, top_p):
-    """The sampling distribution vanilla decoding draws from: softmax of
-    the tempered, top-k/top-p-filtered logits (engine.gumbel_sample's
-    gumbel-argmax samples exactly this). Both p (target) and q (draft)
-    must use the SAME warping or the acceptance ratio is against the
-    wrong measure.
-
-    The warp knobs are PER-ROW [B] vectors (r4 verdict item 5: sampled
-    requests with different temperatures/filters batch into one draft
-    group); logits may be [B, V] or [B, W, V]."""
-    lead = (logits.shape[0],) + (1,) * (logits.ndim - 2)
-    scaled = logits / jnp.maximum(temperature, 1e-6).reshape(lead + (1,))
-    filtered = filter_logits(
-        scaled, top_k.reshape(lead), top_p.reshape(lead)
+def _draw(logits, temperature, top_k, top_p, rng, counter):
+    """One per-row draw through the stepper's shared sampler: per-row
+    warp knobs, per-row key folded with ``counter`` (the token's output
+    index — the position-folded schedule the slot path uses). The
+    repetition-penalty/seen operands are pinned disabled: this engine
+    excludes penalized requests (module docstring), so the no-op
+    operands keep sample_rows the single sampling implementation
+    without threading dead [B, V] state through the round carry."""
+    B = logits.shape[0]
+    return sample_rows(
+        logits, temperature, top_k, top_p,
+        jnp.ones((B,), jnp.float32),
+        jnp.zeros((B, logits.shape[-1]), bool),
+        rng, counter,
     )
-    return jax.nn.softmax(filtered, axis=-1), filtered
 
 
 def _decode_mask(cache_len: int, offsets, q_width: int):
@@ -107,7 +107,7 @@ def _decode_mask(cache_len: int, offsets, q_width: int):
 
 def _prefill_state(
     params, dparams, prompt, prompt_len, cfg, dcfg, max_new, cache_len,
-    k, prefill_chunk, eos_id, sampled, temperature, top_k, top_p, rng_key,
+    k, prefill_chunk, eos_id, temperature, top_k, top_p, rng_key,
 ):
     """Prefill target+draft and build the round-loop carry (round 0
     emits the target's first token, exactly like engine.py's ``first``).
@@ -126,13 +126,15 @@ def _prefill_state(
     dcaches, _ = chunked_prefill(
         dparams, prompt, prompt_len, dcfg, dcaches, prefill_chunk
     )
-    key_first, rng_key = jax.random.split(rng_key)
-    if sampled:
-        # same sampling math as engine.decode_scan's first token
-        _, filt = _warped_dist(t_logits, temperature, top_k, top_p)
-        first = gumbel_pick(t_logits, filt, key_first, temperature)
-    else:
-        first = _greedy(t_logits)  # [B] the target's first token
+    # one key per ROW, counters folded per output index: rows that
+    # accept at different speeds keep drawing independent fresh noise
+    # without any key bookkeeping in the round carry (the stepper's
+    # slot schedule, transplanted to the dense solo engine)
+    rows_rng = jax.random.split(rng_key, B)
+    first = _draw(
+        t_logits, temperature, top_k, top_p, rows_rng,
+        jnp.zeros((B,), jnp.int32),
+    )
 
     # every round may emit up to k+1 tokens past max_new - 1 priors
     written0 = jnp.zeros((B, max_new + k + 1), jnp.int32)
@@ -147,30 +149,31 @@ def _prefill_state(
     )[:, 0]
     return (
         tcaches, dcaches, prev0, first, offsets0, written0, counts0, done0,
-        jnp.zeros((B,), jnp.int32), jnp.int32(0), rng_key,
+        jnp.zeros((B,), jnp.int32), jnp.int32(0), rows_rng,
     )
 
 
 def _one_round(
-    params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+    params, dparams, cfg, dcfg, k, max_new, eos_id,
     temperature, top_k, top_p, carry,
 ):
     """One speculation round over the loop carry: k draft proposals, one
     target verify forward, acceptance, buffer write. Module-level so the
     bulk scan and the incremental group path run the SAME trace."""
     (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-     accepted, rounds, key) = carry
+     accepted, rounds, rows_rng) = carry
     B = prev.shape[0]
     cache_len = tcaches[0][0].shape[1]
-    key, k_draft, k_acc, k_res = jax.random.split(key, 4)
 
     def decode_mask(offsets, q_width):
         return _decode_mask(cache_len, offsets, q_width)
 
-    def draft_propose(dcaches, prev, cur, offsets, key):
-        """k draft steps (greedy argmax, or sampled from the draft's
-        warped distribution q); returns (dcaches, drafts i32[B, k],
-        qdists f32[B, k, V] — zeros in greedy mode).
+    def draft_propose(dcaches, prev, cur, offsets):
+        """k draft steps; proposal i+1 guesses the target draw for
+        output index counts+i, so it samples with the SAME per-row key
+        and counter that draw will fold — a draft matching the target's
+        distribution then proposes the identical token (acceptance 1.0
+        for a self-draft), and any weaker draft only lowers the rate.
 
         The FIRST step runs a 2-token window [prev, cur] (positions
         offsets-1, offsets): after a full-acceptance round the draft
@@ -188,14 +191,10 @@ def _one_round(
             kv_caches=dcaches,
             cache_offset=offsets - 1,
         )
-        if sampled:
-            keys = jax.random.split(key, k)
-            q1, filt1 = _warped_dist(logits[:, 1], temperature, top_k, top_p)
-            d1 = gumbel_pick(logits[:, 1], filt1, keys[0], temperature)
-        else:
-            d1 = _greedy(logits[:, 1])
+        d1 = _draw(logits[:, 1], temperature, top_k, top_p,
+                   rows_rng, counts)
 
-        def step(carry, x):
+        def step(carry, j):
             dcaches, tok, off = carry
             logits, dcaches = forward(
                 dparams, tok[:, None], dcfg,
@@ -204,31 +203,18 @@ def _one_round(
                 kv_caches=dcaches,
                 cache_offset=off,
             )
-            if sampled:
-                qi, filti = _warped_dist(
-                    logits[:, 0], temperature, top_k, top_p
-                )
-                nxt = gumbel_pick(logits[:, 0], filti, x, temperature)
-                return (dcaches, nxt, off + 1), (nxt, qi)
-            nxt = _greedy(logits[:, 0])
-            return (dcaches, nxt, off + 1), (nxt, ())
+            nxt = _draw(logits[:, 0], temperature, top_k, top_p,
+                        rows_rng, counts + j)
+            return (dcaches, nxt, off + 1), nxt
 
-        xs = keys[1:] if sampled else jnp.arange(k - 1)
-        (dcaches, _, _), (rest, rest_q) = jax.lax.scan(
-            step, (dcaches, d1, offsets + 1), xs
+        (dcaches, _, _), rest = jax.lax.scan(
+            step, (dcaches, d1, offsets + 1),
+            jnp.arange(1, k, dtype=jnp.int32),
         )
         drafts = jnp.concatenate([d1[:, None], rest.swapaxes(0, 1)], axis=1)
-        if sampled:
-            qdists = jnp.concatenate(
-                [q1[:, None], rest_q.swapaxes(0, 1)], axis=1
-            )  # [B, k, V]
-        else:
-            qdists = jnp.zeros((B, k, cfg.vocab_size), jnp.float32)
-        return dcaches, drafts, qdists
+        return dcaches, drafts
 
-    dcaches, drafts, qdists = draft_propose(
-        dcaches, prev, cur, offsets, k_draft
-    )
+    dcaches, drafts = draft_propose(dcaches, prev, cur, offsets)
     window = jnp.concatenate([cur[:, None], drafts], axis=1)
     t_logits, tcaches = forward(
         params, window, cfg,
@@ -238,63 +224,27 @@ def _one_round(
         cache_offset=offsets,
     )
 
-    emit_idx = jnp.arange(k + 1)[None, :]
-    if sampled:
-        # Rejection sampling: accept x_i ~ q_i with prob
-        # min(1, p_i(x_i)/q_i(x_i)) — u*q < p avoids the division
-        # (q(x) > 0 whenever x was sampled from q). The first
-        # rejected position resamples from norm(max(p - q, 0));
-        # padding q with a zero row makes the fully-accepted bonus
-        # position the same formula (residual = p_{k+1}).
-        pdists, _ = _warped_dist(t_logits, temperature, top_k, top_p)
-        px = jnp.take_along_axis(
-            pdists[:, :k], drafts[..., None], axis=-1
-        )[..., 0]
-        qx = jnp.take_along_axis(
-            qdists, drafts[..., None], axis=-1
-        )[..., 0]
-        u = jax.random.uniform(k_acc, (B, k))
-        accept_tok = u * qx < px
-        prefix_ok = jnp.cumprod(accept_tok.astype(jnp.int32), axis=1)
-        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
-        q_pad = jnp.concatenate(
-            [qdists, jnp.zeros_like(qdists[:, :1])], axis=1
-        )
-        p_m = jnp.take_along_axis(
-            pdists, m[:, None, None], axis=1
-        )[:, 0]
-        q_m = jnp.take_along_axis(
-            q_pad, m[:, None, None], axis=1
-        )[:, 0]
-        resid = jnp.maximum(p_m - q_m, 0.0)
-        s = jnp.sum(resid, axis=-1, keepdims=True)
-        # all-zero residual (p identical to q under the filters):
-        # every token was acceptable, resample from p directly
-        dist = jnp.where(s > 0, resid / jnp.maximum(s, 1e-38), p_m)
-        logdist = jnp.where(dist > 0, jnp.log(dist), -jnp.inf)
-        repl = jax.random.categorical(k_res, logdist, axis=-1).astype(
-            jnp.int32
-        )
-        emitted = jnp.where(
-            emit_idx < m[:, None],
-            jnp.pad(drafts, ((0, 0), (0, 1))),
-            repl[:, None],
-        )
-    else:
-        targets = _greedy(t_logits)
-        # longest prefix of drafts the target agrees with
-        agree = drafts == targets[:, :k]
-        prefix_ok = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-        m = jnp.sum(prefix_ok, axis=1)  # [B] accepted drafts, 0..k
-
-        # emitted tokens this round: drafts[:, :m] then targets[:, m]
-        # — a static [B, k+1] row whose slots past m duplicate
-        # targets[:, m] (harmless: n_emit bounds what counts)
-        emitted = jnp.where(
-            emit_idx < m[:, None],
-            jnp.pad(drafts, ((0, 0), (0, 1))),
-            jnp.take_along_axis(targets, m[:, None], axis=1),
-        )
+    # the target's own draws at every window position: t_logits[:, i]
+    # conditions on window[0..i]; on the accepted prefix those context
+    # tokens equal the emitted stream, so each emitted draw is exactly
+    # what an unspeculated run would have drawn at that output index
+    # (rejected positions' draws are computed but never emitted). The
+    # k+1 draws are independent given the logits — no repetition
+    # penalty state evolves here — so the loop unrolls statically
+    # instead of scanning.
+    target = jnp.stack(
+        [
+            _draw(t_logits[:, i], temperature, top_k, top_p,
+                  rows_rng, counts + i)
+            for i in range(k + 1)
+        ],
+        axis=1,
+    )
+    # emitted = target: accepted drafts equal the target draw at their
+    # position by the match rule, so the target row already IS the
+    # emitted row — n_emit below bounds what counts
+    emitted = target
+    m = spec_accept(drafts, target) - 1  # [B] accepted drafts, 0..k
     is_eos = (emitted == eos_id) & (eos_id >= 0)
     first_eos = jnp.where(
         is_eos.any(axis=1),
@@ -337,7 +287,7 @@ def _one_round(
     cur = jnp.where(n_emit > 0, new_cur, cur)
     offsets = offsets + n_emit
     return (tcaches, dcaches, prev, cur, offsets, written, counts, done,
-            accepted, rounds, key)
+            accepted, rounds, rows_rng)
 
 
 def _vector_warp(B, temperature, top_k, top_p):
@@ -352,7 +302,7 @@ def _vector_warp(B, temperature, top_k, top_p):
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
-                     "prefill_chunk", "sampled"),
+                     "prefill_chunk"),
 )
 def _spec_generate_jit(
     params: Params,
@@ -366,7 +316,6 @@ def _spec_generate_jit(
     k: int,
     prefill_chunk: int,
     eos_id: jax.Array,  # i32 (negative = never stop)
-    sampled: bool = False,
     temperature: jax.Array | float = 0.0,
     top_k: jax.Array | int = 0,
     top_p: jax.Array | float = 1.0,
@@ -381,13 +330,13 @@ def _spec_generate_jit(
         rng_key = jax.random.PRNGKey(0)
     state0 = _prefill_state(
         params, dparams, prompt, prompt_len, cfg, dcfg, max_new,
-        cache_len, k, prefill_chunk, eos_id, sampled, temperature,
+        cache_len, k, prefill_chunk, eos_id, temperature,
         top_k, top_p, rng_key,
     )
 
     def round_step(carry, _):
         return _one_round(
-            params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+            params, dparams, cfg, dcfg, k, max_new, eos_id,
             temperature, top_k, top_p, carry,
         ), ()
 
@@ -402,32 +351,32 @@ def _spec_generate_jit(
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "dcfg", "max_new", "cache_len", "k",
-                     "prefill_chunk", "sampled"),
+                     "prefill_chunk"),
 )
 def _spec_group_prefill(
     params, dparams, prompt, prompt_len, cfg, dcfg, max_new, cache_len,
-    k, prefill_chunk, eos_id, sampled, temperature, top_k, top_p, rng_key,
+    k, prefill_chunk, eos_id, temperature, top_k, top_p, rng_key,
 ):
     return _prefill_state(
         params, dparams, prompt, prompt_len, cfg, dcfg, max_new,
-        cache_len, k, prefill_chunk, eos_id, sampled, temperature,
+        cache_len, k, prefill_chunk, eos_id, temperature,
         top_k, top_p, rng_key,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "dcfg", "max_new", "k", "sampled"),
+    static_argnames=("cfg", "dcfg", "max_new", "k"),
     donate_argnums=(2,),
 )
 def _spec_group_round(
-    params, dparams, carry, cfg, dcfg, max_new, k, sampled, eos_id,
+    params, dparams, carry, cfg, dcfg, max_new, k, eos_id,
     temperature, top_k, top_p,
 ):
     """One speculation round for a live group (carry donated: the KV
     caches are rewritten in place across rounds)."""
     return _one_round(
-        params, dparams, cfg, dcfg, k, sampled, max_new, eos_id,
+        params, dparams, cfg, dcfg, k, max_new, eos_id,
         temperature, top_k, top_p, carry,
     )
 
@@ -499,7 +448,6 @@ class SpeculativeEngine:
             max_new_tokens, cache_len, self.k,
             prefill_chunk_for(B, int(padded.shape[1])),
             jnp.int32(eos_id),
-            sampled=temperature > 0,
             temperature=jnp.float32(temperature),
             top_k=jnp.int32(top_k),
             top_p=jnp.float32(top_p),
@@ -551,12 +499,13 @@ class SpeculativeEngine:
         top_ps: list[float] | float = 1.0,
         seed: int = 0,
     ) -> "SpecGroup":
-        """Prefill a draft group. Warp knobs are per-row (a sampled group
-        may mix temperatures/filters); the MODE (greedy vs sampled) is
-        group-wide — the batcher drains homogeneous groups. Sampled rows
-        draw from one group key stream seeded by ``seed`` (the head
-        request's): each row's marginal distribution is exactly the
-        target's (the rejection correction is per-row), but token-level
+        """Prefill a draft group. Warp knobs are per-row — greedy and
+        sampled rows share one trace now that both run the same
+        match-acceptance math (a row at temperature 0 just draws its
+        argmax), though the batcher still drains homogeneous groups.
+        Per-row keys derive from one group seed (the head request's):
+        each row's marginal distribution is exactly the target's (every
+        emitted token is the target's own draw), but token-level
         reproducibility is per-group, not per-member."""
         B = len(prompts)
         temperature, top_k, top_p = _vector_warp(
@@ -573,7 +522,7 @@ class SpeculativeEngine:
             self.cfg, self.draft_cfg,
             max_new_tokens, cache_len, self.k,
             prefill_chunk_for(B, int(padded.shape[1])),
-            jnp.int32(eos_id), sampled, temperature, top_k, top_p,
+            jnp.int32(eos_id), temperature, top_k, top_p,
             jax.random.PRNGKey(seed),
         )
         return SpecGroup(
@@ -589,7 +538,7 @@ class SpeculativeEngine:
             return True
         g.state = _spec_group_round(
             self.params, self.draft_params, g.state,
-            self.cfg, self.draft_cfg, g.max_new, self.k, g.sampled,
+            self.cfg, self.draft_cfg, g.max_new, self.k,
             jnp.int32(g.eos_id), g.temperature, g.top_k, g.top_p,
         )
         g.rounds_run += 1

@@ -60,6 +60,7 @@ from kubeinfer_tpu.inference.model import Params, forward
 __all__ = [
     "SlotState", "init_slot_state", "sample_rows", "step_forward",
     "decode_body", "decode_window", "decode_scan", "WINDOW_BUCKETS",
+    "DraftState", "init_draft_state", "spec_accept", "verify_window",
 ]
 
 # Static decode-window horizons: one compiled shape each, so the
@@ -147,15 +148,35 @@ def sample_rows(
     # the full-vocab nucleus sort on every step even with filters off);
     # only the per-row gumbel pick is vmapped
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-    filtered = filter_logits(scaled, top_k, top_p)
 
-    def pick_one(row_logits, row_filtered, key_data, ctr, temp):
-        key = jax.random.fold_in(
-            jax.random.wrap_key_data(key_data, impl="threefry2x32"), ctr
+    def pick_sampled(_):
+        filtered = filter_logits(scaled, top_k, top_p)
+
+        def pick_one(row_logits, row_filtered, key_data, ctr, temp):
+            key = jax.random.fold_in(
+                jax.random.wrap_key_data(key_data, impl="threefry2x32"),
+                ctr,
+            )
+            return gumbel_pick(row_logits, row_filtered, key, temp)
+
+        return jax.vmap(pick_one)(
+            logits, filtered, rng, counter, temperature
         )
-        return gumbel_pick(row_logits, row_filtered, key, temp)
 
-    return jax.vmap(pick_one)(logits, filtered, rng, counter, temperature)
+    def pick_greedy(_):
+        # exactly gumbel_pick's temperature <= 0 branch: argmax of the
+        # RAW (post-penalty) logits, so an all-greedy batch draws
+        # bit-identical tokens to the sampled path's per-row select
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # all-greedy fast path: the per-row threefry fold + full-vocab
+    # gumbel noise is the dominant per-draw cost (not the argmax), and
+    # a verify window draws 2k+1 times per dispatch — skipping the RNG
+    # when no row samples is what keeps speculation ahead of plain
+    # decode on dispatch-bound hosts
+    return jax.lax.cond(
+        jnp.any(temperature > 0), pick_sampled, pick_greedy, None
+    )
 
 
 # --- the shared single-token forward ---------------------------------------
@@ -306,6 +327,265 @@ def decode_window(
     state, toks = jax.lax.scan(step, state, None, length=k)
     # scan stacks on the leading (time) axis; callers want [slot, step]
     return state, jnp.swapaxes(toks, 0, 1)
+
+
+# --- speculative verify window ---------------------------------------------
+
+
+@dataclass
+class DraftState:
+    """Device-resident draft-model state for the verify window, one row
+    per slot. The draft keeps DENSE per-row caches (``[n_slots, Ld,
+    n_kv_d, D_d]``): it is orders of magnitude smaller than the target,
+    so paging it would buy nothing and would couple its block accounting
+    to the pool's. Invariant at rest (target offset ``o``): committed
+    draft KV covers positions ``0 .. o-2``; positions ``o-1`` and ``o``
+    are rewritten by each window's repair forward from ``prev`` and the
+    slot's ``last_token``, so stale KV from rejected proposals is never
+    attended (every position the propose scan reads was either
+    committed, repaired this window, or written earlier in the same
+    scan)."""
+
+    caches_k: list[jax.Array]  # L_d x [n_slots, Ld, n_kv_d, D_d]
+    caches_v: list[jax.Array]
+    prev: jax.Array  # i32[n_slots] token at target position offset - 1
+
+
+jax.tree_util.register_dataclass(
+    DraftState,
+    data_fields=["caches_k", "caches_v", "prev"],
+    meta_fields=[],
+)
+
+
+def init_draft_state(dcfg: ModelConfig, n_slots: int, cache_len: int,
+                     dtype) -> DraftState:
+    # Ld == cache_len suffices: the propose scan's deepest write is
+    # position o + k - 1, and the engine only dispatches verify for
+    # rows with prompt + max_new + k <= cache_len (spec_ok), which
+    # bounds o + k - 1 <= cache_len - 2.
+    shape = (n_slots, cache_len, dcfg.num_key_value_heads, dcfg.head_dim)
+    return DraftState(
+        caches_k=[jnp.zeros(shape, dtype)
+                  for _ in range(dcfg.num_hidden_layers)],
+        caches_v=[jnp.zeros(shape, dtype)
+                  for _ in range(dcfg.num_hidden_layers)],
+        prev=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def spec_accept(drafts: jax.Array, target_toks: jax.Array) -> jax.Array:
+    """THE acceptance rule — the only implementation in the repo
+    (speculative.py routes here too). ``drafts`` i32[B, k] are the
+    proposals; ``target_toks`` i32[B, k+1] are the target model's own
+    samples at the same positions under the same position-folded noise.
+    Draft i survives iff it equals the target's sample AND every
+    earlier draft survived (cumprod); the target's sample after the
+    last survivor is always emitted, so n_emit = m + 1 in [1, k+1].
+
+    Exact-match acceptance (not rejection sampling) is what buys token
+    identity: the emitted row IS the target's sample stream, so the
+    output distribution equals the non-speculative engine's by
+    construction — correlated draft/target noise only moves the
+    acceptance RATE, never the output law."""
+    k = drafts.shape[1]
+    agree = (drafts == target_toks[:, :k]).astype(jnp.int32)
+    m = jnp.cumprod(agree, axis=1).sum(axis=1)
+    return m + 1
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "dcfg", "k", "sharded"),
+    donate_argnums=(1, 3),
+)
+def verify_window(
+    params: Params, state: SlotState,
+    dparams: Params, dstate: DraftState,
+    cfg: ModelConfig, dcfg: ModelConfig, k: int,
+    sharded: bool = False,
+) -> tuple[SlotState, DraftState, jax.Array]:
+    """Speculative twin of :func:`decode_window`: ONE dispatch proposes
+    k draft tokens per live row, scores all k+1 window positions
+    through the block-table attention path ([k+1, G, D] queries — the
+    kernel's verify generalization), and emits each row's accepted
+    prefix. Returns (state, dstate, toks i32[B, k+1]) where row b's
+    first n_emit entries are its emitted tokens and the rest are -1
+    (the same negative-token skip convention the host drain already
+    applies to decode_window's output).
+
+    Token identity with the plain engine is by construction, not by
+    tuning: target tokens are drawn by the SAME :func:`sample_rows`
+    with the SAME position-folded counters the single-step path folds
+    (position p -> counter p), the seen-set evolves only along the
+    emitted (alive) prefix, and ``rng`` is never mutated — so the
+    emitted stream bitwise-equals what decode_window would have
+    produced, window boundaries and acceptance rate notwithstanding.
+
+    Rollback is free on the device side: rejected positions' KV stays
+    in the row's own refcounted blocks but past the new offset, where
+    the next window's scatter-before-attend overwrites it (positions
+    ``o' .. o'+k`` cover the junk span because n_emit <= k+1). The
+    HOST must still never publish those positions (radix inserts stay
+    behind the committed offset — batching's ``toks[:-1]`` rule).
+
+    ``active`` gates everything: inactive rows propose/verify into the
+    null block 0 (static shapes), their n_emit is 0, and their
+    last_token/offset/seen/prev are preserved unchanged.
+
+    Reference divergence: vLLM keeps draft scheduling inside the
+    subprocess (internal/agent/vllm.go:93-112); here the verify window
+    is a first-class engine dispatch so it composes with the paged
+    pool, preemption, and the sharded layout."""
+    B = state.last_token.shape[0]
+    block_size = state.caches_k[0].shape[1]
+    S = state.tables.shape[1] * block_size
+    # a 0-layer (bigram) draft carries no KV at all — Ld then only
+    # shapes the repair mask, which no layer reads; S keeps the shape
+    # well-formed without a dedicated branch downstream
+    Ld = S
+    if dcfg.num_hidden_layers > 0:
+        Ld = dstate.caches_k[0].shape[1]
+    o = state.offset
+    T = k + 1
+
+    # --- draft propose -----------------------------------------------------
+    # Repair forward: rewrite draft KV at positions o-1 (prev) and o
+    # (last_token). This is what makes preemption/rollback cheap — the
+    # draft cache never needs host fixup because the only positions a
+    # fresh window depends on beyond the committed prefix are rebuilt
+    # here from host-verified tokens. dlogits[:, 1] predicts position
+    # o+1, the first proposal.
+    rep_tok = jnp.stack([dstate.prev, state.last_token], axis=1)
+    rep_pos = jnp.stack([o - 1, o], axis=1)
+    rep_mask = (jnp.arange(Ld)[None, None, :] <= rep_pos[:, :, None])
+    dcaches = list(zip(dstate.caches_k, dstate.caches_v))
+    # attn_fn=None -> dense attention: the draft's caches are dense
+    # per-row, and the model is small enough that a kernel would be
+    # dispatch-bound anyway.
+    dlogits, dcaches = forward(
+        dparams, rep_tok, dcfg,
+        positions=rep_pos, attn_mask=rep_mask,
+        kv_caches=dcaches, cache_offset=o - 1,
+    )
+    dseen = state.seen
+    d1 = sample_rows(
+        dlogits[:, 1], state.temperature, state.top_k, state.top_p,
+        state.rep_penalty, dseen, state.rng, o + 1,
+    )
+    dseen = record_seen(dseen, d1, state.rep_penalty)
+
+    if k > 1:
+        def dstep(carry, i):
+            caches_i, tok, seen_i = carry
+            lg, caches_i = step_forward(
+                dparams, dcfg, tok, o + i, caches_i, Ld, sharded=sharded,
+            )
+            nxt = sample_rows(
+                lg, state.temperature, state.top_k, state.top_p,
+                state.rep_penalty, seen_i, state.rng, o + i + 1,
+            )
+            seen_i = record_seen(seen_i, nxt, state.rep_penalty)
+            return (caches_i, nxt, seen_i), nxt
+
+        (dcaches, _, _), rest = jax.lax.scan(
+            dstep, (dcaches, d1, dseen),
+            jnp.arange(1, k, dtype=jnp.int32),
+        )
+        drafts = jnp.concatenate(
+            [d1[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+        )
+    else:
+        drafts = d1[:, None]
+
+    # --- fused verify ------------------------------------------------------
+    # The window's T tokens [last, d_1 .. d_k] occupy logical positions
+    # o .. o+k: decoder_layer scatters their KV into the row's blocks
+    # FIRST, then the T-query kernel attends s <= o + t per query —
+    # exactly the mask below, per decode_attention_blocks_auto's
+    # contract (lengths == o + T).
+    window = jnp.concatenate([state.last_token[:, None], drafts], axis=1)
+    positions = o[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+    lengths = o + T
+
+    def attn_fn(q, kp, vp, m):
+        return decode_attention_blocks_auto(
+            q, kp, vp, state.tables, lengths, m, gspmd=sharded
+        )
+
+    logits, caches = forward(
+        params, window, cfg,
+        positions=positions, attn_mask=mask,
+        kv_caches=list(zip(state.caches_k, state.caches_v)),
+        cache_offset=o, block_tables=state.tables, attn_fn=attn_fn,
+    )
+
+    # --- acceptance --------------------------------------------------------
+    # logits[:, i] predicts position o+1+i; sample it with counter
+    # o+1+i — the identical draw the single-step engine would make at
+    # that position. The scan threads the seen-set along the ALIVE
+    # prefix only: a row's seen must reflect exactly its emitted
+    # tokens, and sampling depends on seen, so acceptance and sampling
+    # have to interleave sequentially (this is VPU-cheap next to the
+    # fused forward above).
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    xs = (
+        jnp.swapaxes(logits, 0, 1),
+        jnp.swapaxes(drafts_pad, 0, 1),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+
+    def astep(carry, xs_i):
+        seen_i, alive = carry
+        lg, d_next, i = xs_i
+        t = sample_rows(
+            lg, state.temperature, state.top_k, state.top_p,
+            state.rep_penalty, seen_i, state.rng, o + 1 + i,
+        )
+        seen_i = jnp.where(
+            alive[:, None],
+            record_seen(seen_i, t, state.rep_penalty),
+            seen_i,
+        )
+        alive = alive & (i < k) & (d_next == t)
+        return (seen_i, alive), t
+
+    (seen_f, _), t_seq = jax.lax.scan(astep, (state.seen, state.active), xs)
+    target = jnp.swapaxes(t_seq, 0, 1)  # [B, T]
+    n_emit = jnp.where(state.active, spec_accept(drafts, target), 0)
+
+    # --- boundary state ----------------------------------------------------
+    # Token at the new offset o' = o + n_emit is target[n_emit-1]; the
+    # one at o'-1 (the next repair window's `prev`) is target[n_emit-2]
+    # when two or more tokens were emitted, else the old last_token.
+    rows = jnp.arange(B)
+    last_new = target[rows, jnp.clip(n_emit - 1, 0, k)]
+    prev_new = jnp.where(
+        n_emit >= 2, target[rows, jnp.clip(n_emit - 2, 0, k)],
+        state.last_token,
+    )
+    keep = state.active
+    new_state = dataclasses.replace(
+        state,
+        caches_k=[c[0] for c in caches],
+        caches_v=[c[1] for c in caches],
+        last_token=jnp.where(keep, last_new, state.last_token),
+        offset=jnp.where(keep, o + n_emit, o),
+        seen=seen_f,  # already alive-masked in-scan; alive_0 = active
+    )
+    new_dstate = dataclasses.replace(
+        dstate,
+        caches_k=[c[0] for c in dcaches],
+        caches_v=[c[1] for c in dcaches],
+        prev=jnp.where(keep, prev_new, dstate.prev),
+    )
+    toks = jnp.where(
+        jnp.arange(T, dtype=jnp.int32)[None, :] < n_emit[:, None],
+        target, -1,
+    )
+    return new_state, new_dstate, toks
 
 
 # --- the per-request / sequence-parallel fused loop ------------------------
