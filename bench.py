@@ -1378,6 +1378,299 @@ def fleet_routing_bench(n_replicas=3, families=6, per_family=4,
     }
 
 
+def disagg_serving_bench(n_long=4, n_short=12, long_new=4, short_new=32,
+                         model="bench-280m", seed=13, parity_new=16):
+    """Disaggregated prefill/decode phase: does moving long-prompt
+    prefill onto a dedicated replica protect decode TPOT on the
+    serving replicas?
+
+    Three topologies, same seeded heavy-tail mix (the serving_slo_bench
+    generator: longs at/near the 512 bucket boundary with small
+    max_new, decode-heavy shorts), each driven concurrently through
+    ``RouterServer.forward`` so longs prefill WHILE shorts decode —
+    the interference this phase exists to measure. The longs are
+    INTERLEAVED through the short train (one long per three shorts)
+    and concurrency is pinned at the decode fleet's slot capacity:
+    with more clients than slots, every admit of a queued request
+    stalls the resident decoders and that churn — identical across
+    topologies — swamps the prefill-displacement signal in the p99.
+    All engines run chunked prefill (Round 9, 4-block chunks): the
+    interleaved baseline must be the BEST interleaving can do, not
+    the pre-chunking strawman:
+
+    - floor: 2 decode replicas, shorts only — the no-long-prefill TPOT
+      floor nothing can beat;
+    - disagg: 1 prefill + 2 decode replicas — longs take the two-phase
+      route (prefill-only export on the prefill replica, KV-block
+      stream + warm admit on a decode replica), so the decode fleet
+      never runs a long prefill dispatch;
+    - interleaved: 3 decode replicas, no prefill role — the same
+      hardware, with long prefills competing in-line against decode
+      steps.
+
+    TPOT p99 is taken over the SHORT requests only, from the replica's
+    own ``kubeinfer.tpot_ms`` response stamp (inter-token decode time,
+    excluding queue-wait and proxy overhead on all three sides — the
+    breakdown's definition), because the shorts are the interactive
+    traffic whose inter-token cadence long prefills stall. The disagg
+    claim is tpot_disagg ~ tpot_floor while tpot_interleaved degrades.
+
+    Also published: ``kv_stream_mbytes_per_sec`` from one direct timed
+    ``/kv/blocks`` fetch (wire bytes / wall time — the transfer-plane
+    throughput the two-phase route pays instead of recompute), and
+    ``disagg_token_parity`` — greedy AND sampled streams through the
+    full export→stream→import→decode path must be token-identical to a
+    cold single-engine ``ContinuousEngine.generate`` (the determinism
+    contract's baseline; batching.py says why the decode replica's
+    token #1 resample matches by the committed-blocks rule).
+
+    The ``bench-280m`` preset matters here (the tiny preset shows the
+    OPPOSITE ordering): the effect under test is prefill COMPUTE
+    displacing decode steps, so a long prefill must cost real matmul
+    time relative to a decode step — on tiny, prefill is ~free and all
+    that's left is the disagg fleet's import-admit overhead on one
+    fewer decode replica. CPU-pinned like every serving phase (the
+    docstrings above say why).
+    """
+    import threading
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.inference.engine import Engine
+    from kubeinfer_tpu.inference.server import InferenceServer
+    from kubeinfer_tpu.router import FleetRouter, RouterServer
+
+    cfg = PRESETS[model]
+    rng = np.random.default_rng(seed)
+    block_size, cache_len, n_slots = 32, 1024, 2
+
+    # serving_slo_bench's heavy-tail generator: near-boundary longs so
+    # prefill compute is uniform across topologies, one-block shorts
+    longs = [
+        (rng.integers(0, cfg.vocab_size,
+                      int(rng.choice([480, 496, 512]))).tolist(),
+         long_new)
+        for _ in range(n_long)
+    ]
+    shorts = [
+        (rng.integers(0, cfg.vocab_size,
+                      int(rng.integers(8, 17))).tolist(), short_new)
+        for _ in range(n_short)
+    ]
+    # distinct fresh prompts for warmup and the two parity probes —
+    # must not share a prefix with the mix or each other so every one
+    # exercises a cold import, not a warm trie hit
+    warm_long = rng.integers(0, cfg.vocab_size, 512).tolist()
+    parity_prompts = [
+        rng.integers(0, cfg.vocab_size, 480).tolist() for _ in range(2)
+    ]
+    stream_prompt = rng.integers(0, cfg.vocab_size, 448).tolist()
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    def mk_fleet(names):
+        fleet = []
+        for name in names:
+            cont = ContinuousEngine(
+                params, cfg, n_slots=n_slots, cache_len=cache_len,
+                block_size=block_size, prefill_chunk_blocks=4,
+            ).start()
+            srv = InferenceServer(
+                Engine(params, cfg), model_id=name, port=0,
+                continuous=cont,
+            ).start()
+            fleet.append((srv, cont))
+        return fleet
+
+    def stop_fleet(fleet):
+        for srv, cont in fleet:
+            srv.stop()
+            cont.stop()
+
+    def run_mix(rs, mix):
+        """Concurrent replay at decode-slot capacity (4 clients): the
+        pool keeps a long in flight alongside decoding shorts for the
+        whole run, without the over-subscription admit churn the
+        docstring above rules out."""
+        def one(item):
+            prompt, max_new = item
+            code, payload = rs.forward(json.dumps(
+                {"prompt": prompt, "max_tokens": max_new}
+            ).encode())
+            if code != 200:
+                raise RuntimeError(f"routed request failed: {code}")
+            _touch_progress()
+            return json.loads(payload)["kubeinfer"]["tpot_ms"]
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            futs = [ex.submit(one, it) for it in mix]
+            return [f.result() for f in futs]
+
+    def phase(n_decode, prefill, mix, short_slice):
+        fleet = mk_fleet([f"d{i}" for i in range(n_decode)]
+                         + (["p0"] if prefill else []))
+        router = FleetRouter()
+        for srv, _ in fleet[:n_decode]:
+            router.add_replica(srv.model_id,
+                               f"http://127.0.0.1:{srv.port}")
+        if prefill:
+            router.add_prefill_replica(
+                "p0", f"http://127.0.0.1:{fleet[-1][0].port}")
+        rs = RouterServer(router)  # forward() driven directly
+        # keep replica views fresh across the compile-heavy warm posts
+        # and the minutes-long 280m mix — a single poll goes DEAD_AFTER_S
+        # stale and the router would 502 with every replica excluded
+        poll_stop = threading.Event()
+
+        def _poll_loop():
+            while not poll_stop.wait(5.0):
+                try:
+                    rs.poll_once()
+                except Exception:
+                    pass
+
+        threading.Thread(target=_poll_loop, daemon=True,
+                         name="bench-disagg-poller").start()
+        handoff = False
+        try:
+            rs.poll_once()
+            # warm every shape the timed mix dispatches (jit cache is
+            # process-global, but the first fleet pays it): long-admit
+            # 512 bucket, short bucket, the decode step AND the fused
+            # decode windows (max_tokens must match the mix's real
+            # max_new values — a 4-token warm never compiles the K=8
+            # window shape the 32-token shorts spend their life in) —
+            # and on the disagg topology the prefill-only export +
+            # _import_blocks shapes via the two-phase route
+            rs.forward(json.dumps(
+                {"prompt": warm_long, "max_tokens": long_new}).encode())
+            rs.forward(json.dumps(
+                {"prompt": warm_long[:12],
+                 "max_tokens": short_new}).encode())
+            _touch_progress()
+            tpots = run_mix(rs, mix)
+            out = {"tpots": [tpots[i] for i in short_slice]}
+            if prefill:
+                # the disagg fleet stays up for the parity/stream probes;
+                # the caller owns cleanup from here
+                out["fleet"] = fleet
+                out["rs"] = rs
+                out["poll_stop"] = poll_stop
+                handoff = True
+            return out
+        finally:
+            if not handoff:
+                poll_stop.set()
+                rs.stop()
+                stop_fleet(fleet)
+
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+        # one long per three shorts, so a long prefill is always in
+        # flight against decoding shorts (4 longs / 12 shorts)
+        mix = []
+        per = max(n_short // n_long, 1)
+        for i, lg in enumerate(longs):
+            mix.append(lg)
+            mix.extend(shorts[i * per:(i + 1) * per])
+        mix.extend(shorts[n_long * per:])
+        short_idx = [i for i, (_, mn) in enumerate(mix)
+                     if mn == short_new]
+
+        floor = phase(2, False, shorts, range(len(shorts)))["tpots"]
+        inter = phase(3, False, mix, short_idx)["tpots"]
+        dg = phase(2, True, mix, short_idx)
+        disagg, fleet, rs = dg["tpots"], dg["fleet"], dg["rs"]
+        poll_stop = dg["poll_stop"]
+        try:
+            pre_srv = fleet[-1][0]
+            # transfer-plane throughput: one prefill-only export on the
+            # prefill replica, then a direct timed /kv/blocks fetch
+            doc = post(pre_srv.port,
+                       {"prompt": stream_prompt, "max_tokens": 0})
+            fp = doc["kubeinfer"]["kv_export"]["fingerprint"]
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{pre_srv.port}/kv/blocks?fp={fp}",
+                timeout=300,
+            ) as r:
+                blob = r.read()
+            stream_mbps = len(blob) / 1e6 / max(
+                time.perf_counter() - t0, 1e-9
+            )
+            _touch_progress()
+            # token parity through the full two-phase route, greedy AND
+            # sampled, vs the cold single-engine baseline
+            routed = []
+            for prompt, extra in zip(
+                parity_prompts,
+                ({}, {"temperature": 0.8, "seed": 7}),
+            ):
+                code, payload = rs.forward(json.dumps(
+                    {"prompt": prompt, "max_tokens": parity_new,
+                     **extra}
+                ).encode())
+                if code != 200:
+                    raise RuntimeError(f"parity request failed: {code}")
+                routed.append(
+                    json.loads(payload)["choices"][0]["tokens"]
+                )
+                _touch_progress()
+        finally:
+            poll_stop.set()
+            rs.stop()
+            stop_fleet(fleet)
+
+        ref_eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            block_size=block_size,
+        ).start()
+        try:
+            ref = [
+                ref_eng.generate(parity_prompts[0],
+                                 max_new_tokens=parity_new),
+                ref_eng.generate(parity_prompts[1],
+                                 max_new_tokens=parity_new,
+                                 temperature=0.8, seed=7),
+            ]
+        finally:
+            ref_eng.stop()
+        parity = routed == ref
+    finally:
+        jax.config.update("jax_default_device", prev_dev)
+    return {
+        "tpot_ms_p99_decode_floor": round(
+            float(np.percentile(np.asarray(floor), 99)), 3
+        ),
+        "tpot_ms_p99_decode_disagg": round(
+            float(np.percentile(np.asarray(disagg), 99)), 3
+        ),
+        "tpot_ms_p99_decode_interleaved": round(
+            float(np.percentile(np.asarray(inter), 99)), 3
+        ),
+        "kv_stream_mbytes_per_sec": round(stream_mbps, 3),
+        # parity is a plain Python list comparison (JSON tokens vs the
+        # reference generate()'s host lists), not a device readback
+        "disagg_token_parity": parity,
+        "disagg_mix_seed": seed,
+    }
+
+
 _last_progress = [0.0]
 
 
@@ -1843,6 +2136,24 @@ def main() -> None:
             extras.update(sharded_serving_bench())
         except Exception as e:
             extras["sharded_serving_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # disaggregated prefill/decode phase (KV-block streaming PR):
+        # short-request decode TPOT p99 on 1-prefill+2-decode vs the
+        # same 3 replicas interleaved vs the no-long-prefill floor,
+        # plus transfer-plane MB/s and the greedy+sampled token-parity
+        # gate on the export→stream→import path
+        try:
+            dg = disagg_serving_bench()
+            for key in (
+                "tpot_ms_p99_decode_floor",
+                "tpot_ms_p99_decode_disagg",
+                "tpot_ms_p99_decode_interleaved",
+                "kv_stream_mbytes_per_sec",
+                "disagg_token_parity", "disagg_mix_seed",
+            ):
+                extras[key] = dg[key]
+        except Exception as e:
+            extras["disagg_serving_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
 
     print(

@@ -830,6 +830,186 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_kv_import(fz: SchedFuzzer):
+    """KV import (disagg/_step_import) racing local admission, a
+    preemption park, LRU eviction, and the stop sweep — over the REAL
+    RadixCache + BlockPool, not a model of them.
+
+    The import path's refcount discipline is the thing under test:
+    alloc (importer's ref) -> write pages -> radix.insert (trie refs
+    NEW nodes) -> unref (importer's ref) leaves imported blocks held by
+    the trie alone, refcount 1 and LRU-evictable — and a duplicate
+    import of an already-cached prefix must free its freshly written
+    blocks right back (dedup by construction). The content oracle pins
+    the other half: a block's bytes are only ever written by the thread
+    that ALLOCATED it, so if eviction or a refcount bug freed a block
+    while an admitted slot still referenced it, a racing alloc would
+    hand the block out, overwrite its content tag, and the slot's
+    stability check trips. Under every schedule: each request reaches
+    exactly one terminal state, matched content never mutates while
+    referenced, and after a full drain-eviction the pool's refs balance
+    to zero.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+
+    BS = 4
+    pool = BlockPool(32, BS)
+    radix = RadixCache(pool)
+    lock = make_lock("schedfuzz.engine-kv-import._lock")
+    pending: list[int] = []
+    slots: dict[int, dict] = {}
+    served: list[int] = []
+    failed: list[int] = []
+    state = {"stopped": False}
+
+    def toks(rid: int) -> list[int]:
+        # two prefix families: even/odd rids share a 2-block prefix, so
+        # imports, admits, and parks collide on the same trie paths
+        return [100 * (rid % 2) + t for t in range(2 * BS)]
+
+    # block content tags: written ONLY at alloc time by the allocating
+    # thread (production writes pages before any reader can match them)
+    contents: dict[int, tuple] = {}
+
+    def alloc_tagged(n: int, tag) -> list[int] | None:
+        # engine->radix->pool is the production lock order; ensure_free
+        # models _step_import's backpressure precheck
+        if not radix.ensure_free(n):
+            return None
+        blocks = pool.alloc(n)
+        contents.update((b, (tag, i)) for i, b in enumerate(blocks))
+        return blocks
+
+    def importer() -> None:
+        # each family lands twice: the second pass is the dedup case —
+        # insert creates no nodes and the unref frees the fresh blocks
+        for fam in (0, 1, 0, 1):
+            with lock:
+                if state["stopped"]:
+                    return
+                blocks = alloc_tagged(2, ("imp", fam))
+                if blocks is None:
+                    continue
+                radix.insert(toks(fam), blocks)
+            pool.unref(blocks)
+
+    def scheduler() -> None:
+        for _ in range(10):
+            # admit phase: longest-prefix match (takes caller refs on
+            # the matched blocks), then alloc the remainder
+            with lock:
+                if state["stopped"]:
+                    return
+                if pending:
+                    rid = pending.pop(0)
+                    matched = radix.match(toks(rid))
+                    sig = [contents[b] for b in matched]
+                    extra = alloc_tagged(2 - len(matched), ("adm", rid))
+                    if extra is None:
+                        pool.unref(matched)
+                        failed.append(rid)
+                    else:
+                        slots[rid] = {
+                            "blocks": matched + extra, "sig": sig,
+                        }
+            # decode phase stand-in: other threads interleave here
+            with lock:
+                pass
+            # retire phase: verify the matched content never moved
+            # while the slot held its refs, cache the blocks, release
+            drain = None
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    rid = next(iter(slots))
+                    row = slots.pop(rid)
+                    n_sig = len(row["sig"])
+                    got = [contents[b] for b in row["blocks"][:n_sig]]
+                    assert got == row["sig"], (rid, got, row["sig"])
+                    radix.insert(toks(rid), row["blocks"])
+                    drain = (rid, row["blocks"])
+            if drain is not None:
+                pool.unref(drain[1])
+                with lock:
+                    served.append(drain[0])
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def parker() -> None:
+        for _ in range(3):
+            parked = None
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    rid = next(iter(slots))
+                    row = slots.pop(rid)
+                    # park caches the committed blocks before the slot
+                    # lets go — the warm-readmit contract
+                    radix.insert(toks(rid), row["blocks"])
+                    parked = (rid, row["blocks"])
+            if parked is None:
+                continue
+            pool.unref(parked[1])
+            with lock:
+                if state["stopped"]:
+                    failed.append(parked[0])
+                else:
+                    pending.append(parked[0])
+
+    def evictor() -> None:
+        # pressure the LRU: evict every trie-only block it can find;
+        # slot-referenced blocks (refcount 2) must survive — the
+        # scheduler's sig check is the oracle that they did
+        for _ in range(3):
+            radix.ensure_free(8)
+            with lock:
+                pass
+
+    def stopper() -> None:
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            leftover = pending[:]
+            pending.clear()
+            live = [(rid, row["blocks"]) for rid, row in slots.items()]
+            slots.clear()
+        for rid, blocks in live:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("import", importer)
+    fz.spawn("sched", scheduler)
+    fz.spawn("park", parker)
+    fz.spawn("evict", evictor)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not pending and not slots, (pending, slots)
+        assert sorted(served + failed) == list(range(6)), (served, failed)
+        # only the trie holds blocks now — every one is refcount 1, so
+        # a full eviction pass must drain the pool to zero (a block a
+        # refcount bug left pinned would make ensure_free come up short)
+        assert radix.ensure_free(31), pool.used_blocks
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -842,6 +1022,7 @@ SCENARIOS = [
     Scenario("engine-multistep", _scn_engine_multistep),
     Scenario("engine-sharded-window", _scn_engine_sharded_window),
     Scenario("engine-spec-rollback", _scn_engine_spec_rollback),
+    Scenario("engine-kv-import", _scn_engine_kv_import),
 ]
 
 

@@ -1,0 +1,107 @@
+"""Import-side client: pull an exported prefix and land it in a local
+engine.
+
+The fetch is an idempotent GET (same blob every time — content
+addressed), so the retry policy classifies like transfer.py's model
+pulls: transport failures and retryable HTTP codes replay, a 404 (the
+export LRU already dropped the entry) fails fast into the caller's
+local-prefill fallback. Verification is layered: wire.decode_payload
+proves the bytes are what the exporter sent (sha256), then the
+fingerprint chain is checked against ``prefix_fingerprints`` over OUR
+tokens — that proves the exporter computed these pages for exactly
+this prompt prefix, guarding against stale exports, fingerprint
+collisions in the export LRU, and block-size drift across the fleet.
+
+Nothing here holds engine locks: the fetch happens on the serving
+HTTP thread, and ``ContinuousEngine.import_prefix`` stages the scatter
+for the scheduler thread (the only ``_state`` writer).
+"""
+
+from __future__ import annotations
+
+import random
+import urllib.parse
+import urllib.request
+
+from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+from kubeinfer_tpu.resilience import RetryPolicy, transient_http
+from kubeinfer_tpu.disagg.wire import KVBlockPayload, decode_payload
+
+# Two attempts: the export is hot right now (the router just created
+# it); if the prefill replica cannot answer within one retry the right
+# move is the local-prefill fallback, not a backoff schedule that eats
+# the TTFT budget the disaggregation exists to protect.
+_FETCH_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.05, max_delay_s=0.2,
+    deadline_s=10.0, classify=transient_http,
+)
+
+
+class KVFetchError(RuntimeError):
+    """KV pull failed after retries (transport or HTTP error)."""
+
+
+def fetch_kv_blocks(
+    base_url: str,
+    fingerprint: int,
+    timeout_s: float = 10.0,
+    rng: random.Random | None = None,
+) -> KVBlockPayload:
+    """GET ``/kv/blocks?fp=<fingerprint>`` from a prefill replica and
+    decode. Raises KVFetchError (transport/HTTP) or WireError
+    (corruption) — callers treat both as 'fall back to local
+    prefill'."""
+    url = (
+        base_url.rstrip("/") + "/kv/blocks?"
+        + urllib.parse.urlencode({"fp": int(fingerprint)})
+    )
+
+    def attempt() -> bytes:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read()
+
+    try:
+        blob = _FETCH_POLICY.call(attempt, edge="disagg.fetch", rng=rng)
+    except Exception as e:  # noqa: BLE001 — any failure means fallback
+        raise KVFetchError(
+            f"kv fetch from {base_url} failed: {type(e).__name__}: {e}"
+        ) from e
+    return decode_payload(blob)
+
+
+def import_remote_prefix(
+    engine,
+    tokens: list[int],
+    base_url: str,
+    timeout_s: float = 10.0,
+    rng: random.Random | None = None,
+) -> tuple[int, str | None, int]:
+    """Fetch this prompt's exported prefix and import it into
+    ``engine``'s pool + radix cache. Returns ``(blocks_imported,
+    fallback_reason, wire_bytes)`` — reason is None on success, else a
+    low-cardinality label for kubeinfer_disagg_fallbacks_total. Never
+    raises: every failure mode degrades to local prefill, which is
+    token-identical by the determinism contract."""
+    bs = int(engine.block_size)
+    fps = prefix_fingerprints(tokens, bs)
+    if not fps:
+        return 0, "no_full_block", 0
+    try:
+        payload = fetch_kv_blocks(
+            base_url, fps[-1], timeout_s=timeout_s, rng=rng,
+        )
+    except KVFetchError:
+        return 0, "fetch_error", 0
+    except Exception:  # noqa: BLE001 — WireError & friends
+        return 0, "wire_error", 0
+    # Content-address check: the FULL chain must match, not just the
+    # deepest value we asked for — a same-depth collision in the export
+    # LRU would otherwise scatter someone else's KV under our tokens.
+    if payload.block_size != bs or list(payload.fingerprints) != fps:
+        return 0, "fingerprint_mismatch", payload.byte_size
+    imported, reason = engine.import_prefix(
+        tokens[: len(fps) * bs],
+        payload.pages_k, payload.pages_v,
+        timeout_s=timeout_s,
+    )
+    return imported, reason, payload.byte_size

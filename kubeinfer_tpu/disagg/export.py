@@ -1,0 +1,79 @@
+"""Export-side staging: recently prefilled prefixes, addressed by
+fingerprint, held as ready-to-serve wire blobs.
+
+Divergence from the reference plane this mirrors (model_server.go:26-130
+serves a static file listing once per rollout): prefills are produced
+continuously and consumed at most a handful of times each (the decode
+replica the router picked, plus retries), so the export side is a small
+LRU keyed by the prefix's DEEPEST rolling fingerprint — the same value
+the importer recomputes from its own prompt tokens, which is what makes
+the lookup a content address rather than a session handle. Entries
+store encoded wire bytes (wire.encode_payload), not arrays: the sha256
+is paid once at put time on the scheduler thread's captured pages, and
+the HTTP handler serves byte blobs without touching engine state.
+
+Capacity is entries, not bytes, because entry size is bounded by the
+engine's own cache_len — the pool could not have produced a bigger
+prefix than it holds. Eviction drops the least recently PUT-or-GOT
+entry; a dropped export only costs the importer a fallback to local
+prefill (token-identical by the determinism contract), never
+correctness.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from kubeinfer_tpu.analysis.racecheck import guard, make_lock
+
+DEFAULT_EXPORT_CAPACITY = 32
+
+
+class KVExportCache:
+    """Bounded LRU of wire-encoded KV exports keyed by fingerprint."""
+
+    def __init__(self, capacity: int = DEFAULT_EXPORT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = make_lock("disagg.KVExportCache._lock")
+        self._entries: OrderedDict[int, bytes] = OrderedDict()
+        self.puts = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        guard(self)
+
+    def put(self, fingerprint: int, blob: bytes) -> None:
+        with self._lock:
+            self._entries[int(fingerprint)] = blob
+            self._entries.move_to_end(int(fingerprint))
+            self.puts += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def get(self, fingerprint: int) -> bytes | None:
+        with self._lock:
+            blob = self._entries.get(int(fingerprint))
+            if blob is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(int(fingerprint))
+            self.hits += 1
+            return blob
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "puts": self.puts,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

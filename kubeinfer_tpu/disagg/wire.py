@@ -1,0 +1,170 @@
+"""KV-block wire format: one self-verifying blob per exported prefix.
+
+Framing is a single JSON header line followed by the raw page bytes —
+the same shape as transfer.py's model plane (text metadata + opaque
+body, sha256 over the body), chosen so a torn or truncated stream is
+always detectable before any page reaches a pool. The header carries
+everything an importer must agree on BEFORE scattering: dtype, page
+shape, block size, and the rolling prefix fingerprints that
+content-address each block (kv_blocks.prefix_fingerprints — both sides
+chain the identical FNV function, so a fingerprint match proves the
+exporter computed these pages for exactly this token prefix).
+
+Pages travel as two dense arrays, ``[layers, blocks, *page_shape]`` for
+K then V. Block ids never cross the wire — they are pool-local on both
+ends; position in the array IS the logical index. No tensor-parallel
+metadata either: pages are whole along every axis (the exporter
+gathers replicated logical blocks, the importer scatters into its own
+layout), per the package's layout audit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+import numpy as np
+
+_MAGIC = "kubeinfer-kvwire/1"
+
+# Header stays a bounded parse even against a hostile peer: fingerprint
+# lists are capped by pool size in practice (blocks <= num_blocks), but
+# a corrupt length field must not make us allocate the body blindly.
+_MAX_HEADER_BYTES = 1 << 20
+
+
+class WireError(RuntimeError):
+    """Malformed, truncated, or checksum-failed KV payload."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVBlockPayload:
+    """Decoded KV export: ``pages_k``/``pages_v`` are
+    ``[layers, blocks, block_size, n_kv_heads, head_dim]`` numpy arrays;
+    ``fingerprints[i]`` content-addresses the prefix through block i."""
+
+    pages_k: np.ndarray
+    pages_v: np.ndarray
+    fingerprints: tuple[int, ...]
+    block_size: int
+
+    @property
+    def blocks(self) -> int:
+        return int(self.pages_k.shape[1])
+
+    @property
+    def byte_size(self) -> int:
+        return self.pages_k.nbytes + self.pages_v.nbytes
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Numpy first; jax's extension dtypes (bfloat16) register with
+    ml_dtypes, which ships with jax — lazy import keeps this module
+    usable in tools that have numpy only."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes  # noqa: PLC0415 — optional, jax brings it
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise WireError(f"unresolvable dtype {name!r}") from e
+
+
+def encode_payload(
+    pages_k: np.ndarray,
+    pages_v: np.ndarray,
+    fingerprints: list[int] | tuple[int, ...],
+    block_size: int,
+) -> bytes:
+    if pages_k.shape != pages_v.shape or pages_k.dtype != pages_v.dtype:
+        raise WireError(
+            f"K/V pages disagree: {pages_k.shape}/{pages_k.dtype} vs "
+            f"{pages_v.shape}/{pages_v.dtype}"
+        )
+    if pages_k.ndim != 5:
+        raise WireError(
+            f"pages must be [layers, blocks, bs, n_kv, D], got "
+            f"shape {pages_k.shape}"
+        )
+    if len(fingerprints) != pages_k.shape[1]:
+        raise WireError(
+            f"{len(fingerprints)} fingerprints for "
+            f"{pages_k.shape[1]} blocks"
+        )
+    pages_k = np.ascontiguousarray(pages_k)
+    pages_v = np.ascontiguousarray(pages_v)
+    body = pages_k.tobytes() + pages_v.tobytes()
+    header = {
+        "magic": _MAGIC,
+        "dtype": pages_k.dtype.name,
+        "layers": int(pages_k.shape[0]),
+        "blocks": int(pages_k.shape[1]),
+        "page_shape": [int(d) for d in pages_k.shape[2:]],
+        "block_size": int(block_size),
+        "fingerprints": [int(fp) for fp in fingerprints],
+        "body_bytes": len(body),
+        "body_sha256": hashlib.sha256(body).hexdigest(),
+    }
+    return json.dumps(header).encode() + b"\n" + body
+
+
+def decode_payload(blob: bytes) -> KVBlockPayload:
+    nl = blob.find(b"\n", 0, _MAX_HEADER_BYTES)
+    if nl < 0:
+        raise WireError("no header line within bound")
+    try:
+        header = json.loads(blob[:nl])
+    except ValueError as e:
+        raise WireError(f"header is not JSON: {e}") from e
+    if not isinstance(header, dict) or header.get("magic") != _MAGIC:
+        raise WireError(f"bad magic {header.get('magic')!r}"
+                        if isinstance(header, dict)
+                        else "header is not an object")
+    body = blob[nl + 1:]
+    try:
+        layers = int(header["layers"])
+        blocks = int(header["blocks"])
+        page_shape = tuple(int(d) for d in header["page_shape"])
+        block_size = int(header["block_size"])
+        fingerprints = tuple(int(fp) for fp in header["fingerprints"])
+        body_bytes = int(header["body_bytes"])
+        want_sha = str(header["body_sha256"])
+        dtype = _resolve_dtype(str(header["dtype"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed header: {e}") from e
+    if len(page_shape) != 3 or page_shape[0] != block_size:
+        raise WireError(
+            f"page_shape {page_shape} inconsistent with "
+            f"block_size {block_size}"
+        )
+    if len(fingerprints) != blocks:
+        raise WireError(
+            f"{len(fingerprints)} fingerprints for {blocks} blocks"
+        )
+    if len(body) != body_bytes:
+        raise WireError(
+            f"truncated body: {len(body)} of {body_bytes} bytes"
+        )
+    got_sha = hashlib.sha256(body).hexdigest()
+    if got_sha != want_sha:
+        raise WireError(
+            f"checksum mismatch (got {got_sha[:12]}…, "
+            f"want {want_sha[:12]}…)"
+        )
+    per_side = layers * blocks * int(np.prod(page_shape)) * dtype.itemsize
+    if len(body) != 2 * per_side:
+        raise WireError(
+            f"body is {len(body)} bytes, header shapes imply "
+            f"{2 * per_side}"
+        )
+    shape = (layers, blocks) + page_shape
+    pages_k = np.frombuffer(body[:per_side], dtype=dtype).reshape(shape)
+    pages_v = np.frombuffer(body[per_side:], dtype=dtype).reshape(shape)
+    return KVBlockPayload(
+        pages_k=pages_k, pages_v=pages_v,
+        fingerprints=fingerprints, block_size=block_size,
+    )

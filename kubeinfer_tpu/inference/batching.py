@@ -312,6 +312,43 @@ def _admit_draft(
     )
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _import_blocks(
+    state: SlotState,
+    table_row: jax.Array,  # i32[max_blocks] freshly allocated block ids
+    own_mask: jax.Array,  # bool[max_blocks] True = real imported page
+    pages_k: jax.Array,  # [L, max_blocks, bs, n_kv, D], zero-padded
+    pages_v: jax.Array,
+) -> SlotState:
+    """Scatter fetched KV pages into the pool (disaggregated prefill:
+    a prefill replica computed them, wire.py carried them, the host
+    staged them — kubeinfer_tpu/disagg/). Same own-mask discipline as
+    ``_admit_slot``'s put: padding entries point at the null block with
+    ``own=False``, so every duplicate scatter index carries the block's
+    current value (deterministic by construction), and the pages tensor
+    is always padded to ``max_blocks`` — ONE compiled shape per engine
+    config, never one per prefix length. No slot state is touched: the
+    import only materializes pool blocks; the request that wants them
+    admits through the ordinary warm path afterwards, which is what
+    makes a remote prefix token-identical to a radix hit."""
+    own = own_mask[:, None, None, None]
+
+    def put(pool, pages):
+        return pool.at[table_row].set(
+            jnp.where(own, pages, pool[table_row])
+        )
+
+    return dataclasses.replace(
+        state,
+        caches_k=[
+            put(b, pages_k[i]) for i, b in enumerate(state.caches_k)
+        ],
+        caches_v=[
+            put(b, pages_v[i]) for i, b in enumerate(state.caches_v)
+        ],
+    )
+
+
 # --- host-side scheduler ---------------------------------------------------
 
 
@@ -399,6 +436,15 @@ class _Request:
     # carried onto the engine.decode span at retirement
     spec_accepted: int = 0
     spec_rollbacks: int = 0
+    # disaggregated prefill (disagg/): export_kv asks the scheduler to
+    # capture this request's committed full-block pages at finalize
+    # time — the ONLY thread where reading _state is safe (jit donation
+    # deletes the buffers HTTP threads would race). kv_export is the
+    # captured dict (pages_k/pages_v/fingerprints/block_size), read by
+    # the server after done is set (the Event is the happens-before
+    # edge, same contract as the timeline fields above).
+    export_kv: bool = False
+    kv_export: dict | None = None
 
     @property
     def pending_since(self) -> float:
@@ -433,6 +479,23 @@ class _PrefillTask:
     # the plan reserved verify slack (spec_k extra positions), so the
     # finalize also prefills the slot's draft-cache row
     spec_ok: bool = False
+
+
+@dataclass
+class _ImportTask:
+    """One staged KV import (disaggregated prefill): an HTTP thread
+    fetched and verified the pages (disagg/client.py), the scheduler
+    thread scatters them — it is the only ``_state`` writer, so the
+    handoff is a queue + Event rather than a lock around device state.
+    ``tokens`` covers exactly the imported full blocks (n * block_size
+    tokens); ``pages_k``/``pages_v`` are ``[L, n, bs, n_kv, D]``."""
+
+    tokens: list[int]
+    pages_k: np.ndarray
+    pages_v: np.ndarray
+    done: threading.Event = field(default_factory=threading.Event)
+    imported: int = 0
+    reason: str | None = None
 
 
 class ContinuousEngine:
@@ -553,6 +616,13 @@ class ContinuousEngine:
         # scheduler pass, FIFO) and preempted requests awaiting readmit
         self._prefills: list[_PrefillTask] = []
         self._parked: list[_Request] = []
+        # staged KV imports (disaggregated prefill, disagg/): appended
+        # by HTTP threads under _lock, serviced one per scheduler pass
+        # by _step_import, swept by _fail_inflight like every other
+        # handoff field
+        self._imports: list[_ImportTask] = []
+        self.imports_total = 0  # telemetry: serviced KV imports
+        self.imported_blocks_total = 0  # telemetry: blocks scattered in
         # cooldown ticks on decode steps; start past the gate so the
         # first pressure spike can preempt immediately
         self._steps_since_preempt = 1 << 30
@@ -682,7 +752,8 @@ class ContinuousEngine:
                eos_id: int = -1, temperature: float = 0.0,
                seed: int = 0, top_k: int = 0,
                top_p: float = 1.0,
-               repetition_penalty: float = 1.0) -> _Request:
+               repetition_penalty: float = 1.0,
+               export_kv: bool = False) -> _Request:
         if not prompt:
             raise ValueError("empty prompt")
         if not self.fits(len(prompt), max_new_tokens):
@@ -696,7 +767,8 @@ class ContinuousEngine:
             )
         req = _Request(prompt, max_new_tokens, eos_id,
                        temperature=temperature, top_k=top_k, top_p=top_p,
-                       rep_penalty=repetition_penalty, seed=seed)
+                       rep_penalty=repetition_penalty, seed=seed,
+                       export_kv=export_kv)
         # capture the submitter's trace context here (scheduler runs on
         # its own thread, where the thread-local stack is empty); no
         # inbound context still gets a per-request trace anchor
@@ -713,14 +785,16 @@ class ContinuousEngine:
               eos_id: int = -1, temperature: float = 0.0,
               seed: int = 0, top_k: int = 0, top_p: float = 1.0,
               repetition_penalty: float = 1.0,
-              timeout: float = 300.0) -> _Request:
+              timeout: float = 300.0,
+              export_kv: bool = False) -> _Request:
         """submit() + wait, returning the completed request object so
         callers (the HTTP server's latency-breakdown histograms) can
         read the timeline fields alongside the tokens."""
         req = self.submit(prompt, max_new_tokens, eos_id,
                           temperature=temperature, seed=seed,
                           top_k=top_k, top_p=top_p,
-                          repetition_penalty=repetition_penalty)
+                          repetition_penalty=repetition_penalty,
+                          export_kv=export_kv)
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
@@ -758,6 +832,104 @@ class ContinuousEngine:
         own lock."""
         return self._radix.summary()
 
+    def import_prefix(self, tokens: list[int], pages_k: np.ndarray,
+                      pages_v: np.ndarray,
+                      timeout_s: float = 10.0) -> tuple[int, str | None]:
+        """Land a remotely prefilled prefix in the local pool + radix
+        cache (disaggregated prefill, disagg/). Callable from any
+        thread: the scatter is staged for the scheduler thread — the
+        only ``_state`` writer — and this call waits for it. Returns
+        ``(blocks_imported, reason)``; reason is None on success, else
+        a low-cardinality fallback label. Never raises: every failure
+        here just means the request prefills locally (token-identical
+        by the determinism contract).
+
+        ``tokens`` must cover exactly the imported full blocks and
+        ``pages_k``/``pages_v`` be ``[L, n, block_size, n_kv, D]`` in
+        the cache dtype — the caller (disagg.client) has already
+        verified the fingerprint chain, so a shape mismatch here means
+        a mis-configured fleet, not corruption."""
+        if pages_k.ndim != 5 or pages_k.shape != pages_v.shape:
+            return 0, "shape_mismatch"
+        n = int(pages_k.shape[1])
+        if n == 0 or n > self.max_blocks or \
+                len(tokens) != n * self.block_size:
+            return 0, "shape_mismatch"
+        if self._stop.is_set() or self._thread is None:
+            return 0, "stopped"
+        task = _ImportTask(list(tokens), pages_k, pages_v)
+        with self._lock:
+            self._imports.append(task)
+        self._note("import_staged", blocks=n)
+        if not task.done.wait(timeout_s):
+            # the scheduler may still service the task later — that
+            # only warms the trie; the caller stops waiting and
+            # prefills locally
+            return 0, "timeout"
+        return task.imported, task.reason
+
+    def _step_import(self) -> None:
+        """Service at most ONE staged KV import per scheduler pass —
+        the same pass quantum as chunked prefill, so a burst of imports
+        never starves the decode batch. Runs on the scheduler thread
+        only (the sole ``_state`` writer); alloc → scatter → trie
+        insert → drop our alloc hold, leaving the imported blocks at
+        trie-only refcount exactly like a parked prefix: LRU-evictable,
+        never pool-pinning. Spans already cached keep the existing
+        trie nodes and our duplicate fresh blocks free right back —
+        dedup by construction (freed blocks hold junk pages, harmless:
+        every owned block is fully rewritten before any read)."""
+        with self._lock:
+            task = self._imports.pop(0) if self._imports else None
+        if task is None:
+            return
+        n = int(task.pages_k.shape[1])
+        L = len(self._state.caches_k)
+        _nb, bs, n_kv, D = self._state.caches_k[0].shape
+        want = (L, n, bs, n_kv, D)
+        cache_dt = np.dtype(self._state.caches_k[0].dtype)
+        if (
+            tuple(task.pages_k.shape) != want
+            or tuple(task.pages_v.shape) != want
+            or np.dtype(task.pages_k.dtype) != cache_dt
+            or np.dtype(task.pages_v.dtype) != cache_dt
+        ):
+            task.reason = "shape_mismatch"
+            self._note("import_reject", blocks=n, reason=task.reason)
+            task.done.set()
+            return
+        # trie/pool mutations take _lock (HTTP threads walk the trie in
+        # cache_summary); the jit scatter between them stays OFF-lock —
+        # only this thread allocs, so the two sections can't interleave
+        with self._lock:
+            if not self._radix.ensure_free(n):
+                task.reason = "backpressure"
+                self._note("import_reject", blocks=n, reason=task.reason)
+                task.done.set()
+                return
+            fresh = self._pool.alloc(n)
+        table_row = np.zeros(self.max_blocks, np.int32)
+        table_row[:n] = fresh
+        own_mask = np.zeros(self.max_blocks, bool)
+        own_mask[:n] = True
+        pk = np.zeros((L, self.max_blocks, bs, n_kv, D), cache_dt)
+        pk[:, :n] = task.pages_k
+        pv = np.zeros((L, self.max_blocks, bs, n_kv, D), cache_dt)
+        pv[:, :n] = task.pages_v
+        # lint: allow[lock-discipline] scheduler thread is the only _state writer; see _loop
+        self._state = _import_blocks(
+            self._state, jnp.asarray(table_row), jnp.asarray(own_mask),
+            jnp.asarray(pk), jnp.asarray(pv),
+        )
+        with self._lock:
+            created = self._radix.insert(task.tokens, fresh)
+            self._pool.unref(fresh)
+        self.imports_total += 1
+        self.imported_blocks_total += n
+        task.imported = n
+        self._note("import", blocks=n, created_nodes=created)
+        task.done.set()
+
     def scheduler_stats(self) -> dict:
         """Preemption/chunking accounting for /metrics: monotonic
         preempt/resume/chunk counters (the server converts them by
@@ -779,6 +951,9 @@ class ContinuousEngine:
             "spec_draft_tokens": self.spec_draft_tokens,
             "spec_accepted_tokens": self.spec_accepted_tokens,
             "spec_rollbacks": self.spec_rollbacks,
+            # disaggregated prefill: serviced imports / blocks landed
+            "kv_imports": self.imports_total,
+            "kv_imported_blocks": self.imported_blocks_total,
         }
 
     def _note(self, kind: str, **detail) -> None:
@@ -915,6 +1090,10 @@ class ContinuousEngine:
             # were popped from the pending order, so nothing else will
             # serve them)
             staged, self._staged = self._staged, []
+            # staged KV imports hold no pool references yet (alloc
+            # happens in _step_import); releasing their waiters is the
+            # whole cleanup
+            imports, self._imports = self._imports, []
             # chunked-prefill tasks' requests are already published in
             # _slot_req (the slot is reserved at plan time), so the
             # slot sweep below releases them; only the task list needs
@@ -943,6 +1122,9 @@ class ContinuousEngine:
             req.failed = "engine stopped before the request was served"
             req.done.set()
             failed += 1
+        for task in imports:
+            task.reason = "stopped"
+            task.done.set()
         if group is not None:
             for req in group[0]:
                 req.failed = "engine stopped mid-generation"
@@ -1227,12 +1409,51 @@ class ContinuousEngine:
             self._radix.insert(
                 tokens, [int(b) for b in task.table_row[:full]]
             )
-        # the prefill already produced the next generated token
+        if req.export_kv and full:
+            # disaggregated prefill export (disagg/): capture the
+            # committed full-block pages HERE — the scheduler thread is
+            # the only safe _state reader (jit donation deletes buffers
+            # under any racing HTTP-thread read), and right after the
+            # insert above the trie holds exactly these blocks. The
+            # fingerprints ride out of the trie walk
+            # (match_with_fingerprints) so the wire's content addresses
+            # are the very chain the router and importers recompute.
+            idx = jnp.asarray(
+                np.asarray(task.table_row[:full], np.int32)
+            )
+            pages_k = np.stack([
+                # lint: allow[host-sync] export capture: the prefilled pages must reach host memory before the request completes (one gather per layer, prefill-only requests never decode)
+                np.asarray(ck[idx]) for ck in self._state.caches_k
+            ])
+            pages_v = np.stack([
+                # lint: allow[host-sync] export capture (same boundary as pages_k above)
+                np.asarray(cv[idx]) for cv in self._state.caches_v
+            ])
+            pairs = self._radix.match_with_fingerprints(
+                tokens[:full * self.block_size]
+            )
+            # the walk refs its matches for us; the slot already holds
+            # these blocks, so the extra hold is returned immediately
+            self._pool.unref([b for b, _ in pairs])
+            req.kv_export = {
+                "pages_k": pages_k,
+                "pages_v": pages_v,
+                "fingerprints": [fp for _, fp in pairs],
+                "block_size": self.block_size,
+            }
+        # the prefill already produced the next generated token —
+        # except in prefill-only mode (max_new == 0, the disagg export
+        # role), where the sampled token is discarded: the request's
+        # contract is "KV cached, nothing generated", and the decode
+        # replica resamples token #1 itself from the identical
+        # distribution (committed-blocks rule: it recomputes the last
+        # prompt position)
         # lint: allow[host-sync] admission boundary: the first token must reach the request result now
         first = int(self._state.last_token[slot])
-        req.out_tokens.append(first)
         now = tracing.now()
-        req.token_times.append(now)
+        if req.max_new > 0:
+            req.out_tokens.append(first)
+            req.token_times.append(now)
         if not task.resumed:
             req.t_first = now
         # one profiler record per prefill dispatch, bracketing the
@@ -1777,6 +1998,11 @@ class ContinuousEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # staged KV imports first (at most one per pass): an import
+            # usually precedes the very request that wants its blocks,
+            # so servicing it ahead of admissions turns that request's
+            # admit into a warm one instead of a cold prefill
+            self._step_import()
             with self._lock:
                 busy = any(r is not None for r in self._slot_req)
                 idle = (not busy and self._spec_group is None

@@ -263,6 +263,31 @@ class RadixCache:
             self._pool.ref(out)
             return out
 
+    def match_with_fingerprints(
+        self, tokens: Sequence[int],
+    ) -> list[tuple[int, int]]:
+        """``match()`` plus each matched node's path fingerprint:
+        ``[(block, fp), ...]`` shallowest first, where ``fp`` covers
+        tokens[0 : (i+1)*block_size] — the exact chain
+        ``prefix_fingerprints`` would recompute. The KV export side
+        content-addresses blocks with these (disagg/export.py) without
+        re-hashing the prompt. Same reference contract as ``match()``:
+        one caller-owned reference per returned block, unref what you
+        don't consume."""
+        with self._lock:
+            self._clock += 1
+            out: list[tuple[int, int]] = []
+            node = self._root
+            for key in self._keys(tokens):
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.stamp = self._clock
+                out.append((child.block, child.fp))
+                node = child
+            self._pool.ref([b for b, _ in out])
+            return out
+
     def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
         """Cache the full blocks of ``tokens``: blocks[i] holds tokens
         [i*bs, (i+1)*bs). Existing nodes keep their block (the caller's

@@ -288,6 +288,36 @@ def _serving_metrics(registry: Registry):
             "Signed remaining budget fraction over the longest window",
             labels=("slo",), registry=registry,
         ),
+        # disaggregated prefill/decode (disagg/): the KV transfer plane
+        # observed from BOTH ends — direction=export counts blocks/bytes
+        # served at /kv/blocks, direction=import counts blocks/bytes
+        # landed via ContinuousEngine.import_prefix; fallbacks are the
+        # paths that degraded to local prefill (token-identical, so a
+        # fallback is a latency event, never a correctness one)
+        "kv_stream_blocks": Counter(
+            "kubeinfer_kv_stream_blocks_total",
+            "KV blocks streamed over the transfer plane",
+            labels=("direction",), registry=registry,
+        ),
+        "kv_stream_bytes": Counter(
+            "kubeinfer_kv_stream_bytes_total",
+            "Wire bytes streamed over the KV transfer plane",
+            labels=("direction",), registry=registry,
+        ),
+        "kv_stream_seconds": Histogram(
+            "kubeinfer_kv_stream_seconds",
+            "KV transfer-plane operation latency (export = serve the "
+            "blob; import = fetch + verify + scatter)",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0),
+            labels=("direction",), registry=registry,
+        ),
+        "disagg_fallbacks": Counter(
+            "kubeinfer_disagg_fallbacks_total",
+            "Disaggregated-prefill requests that fell back to local "
+            "prefill, by reason",
+            labels=("reason",), registry=registry,
+        ),
     }
 
 
@@ -312,6 +342,16 @@ class InferenceServer:
         self.slo = slo if slo is not None else SLOMonitor()
         self.registry = Registry()
         self.metrics = _serving_metrics(self.registry)
+        # disaggregated-prefill export staging (disagg/export.py):
+        # prefill-only completions park their wire-encoded KV here,
+        # keyed by deepest prefix fingerprint, until a decode replica
+        # pulls it from /kv/blocks. Only meaningful with a continuous
+        # engine (the paged pool is what gets exported).
+        self.kv_exports = None
+        if continuous is not None:
+            from kubeinfer_tpu.disagg.export import KVExportCache
+
+            self.kv_exports = KVExportCache()
         # last-seen monotonic kv_cache_stats counters, for the
         # delta-to-Counter conversion at scrape time; guarded because
         # ThreadingHTTPServer can run concurrent /metrics scrapes
@@ -383,6 +423,51 @@ class InferenceServer:
                         "model": server.model_id,
                         "serving": serving,
                     }))
+                elif path == "/kv/blocks":
+                    # disaggregated-prefill transfer plane: serve one
+                    # exported prefix by content address (deepest
+                    # rolling fingerprint). Unauthenticated like
+                    # /cache/summary — the fleet's pod network — and
+                    # self-verifying on the wire (sha256 in the header,
+                    # wire.py), so a torn read never reaches a pool.
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        fp = int((q.get("fp") or [""])[0])
+                    except ValueError:
+                        self.respond(400, "application/json", json.dumps(
+                            {"error": "fp must be an integer fingerprint"}
+                        ))
+                        return
+                    blob = (
+                        server.kv_exports.get(fp)
+                        if server.kv_exports is not None else None
+                    )
+                    if blob is None:
+                        # evicted from the export LRU (or never made):
+                        # the importer falls back to local prefill
+                        self.respond(404, "application/json", json.dumps(
+                            {"error": "no export for fingerprint"}
+                        ))
+                        return
+                    try:
+                        hdr = json.loads(blob[:blob.find(b"\n")])
+                        nblocks = int(hdr.get("blocks", 0))
+                    except ValueError:
+                        nblocks = 0
+                    # count BEFORE the socket write: the importer's very
+                    # next request may scrape /metrics, and the counters
+                    # must already reflect the blob it just received
+                    server.metrics["kv_stream_blocks"].inc(
+                        "export", by=nblocks
+                    )
+                    server.metrics["kv_stream_bytes"].inc(
+                        "export", by=len(blob)
+                    )
+                    t0 = time.perf_counter()
+                    self.respond(200, "application/octet-stream", blob)
+                    server.metrics["kv_stream_seconds"].observe(
+                        "export", time.perf_counter() - t0
+                    )
                 elif path == "/debug/flightrecorder":
                     fl = (server.continuous.flight.to_dict()
                           if server.continuous is not None
@@ -605,29 +690,33 @@ class InferenceServer:
         self.metrics["completion_tokens"].inc(
             by=resp["usage"]["completion_tokens"]
         )
-        ttft = self._observe_breakdown(
+        ttft, tpot = self._observe_breakdown(
             route, dur, resp["usage"]["completion_tokens"],
             route_box.get("timing"),
         )
         # non-OpenAI extension: the serving timeline as the SERVER saw
-        # it. The fleet router/bench compare replicas by TTFT, and a
-        # client-side wall clock would fold proxy+network time into the
-        # very signal being compared.
+        # it. The fleet router/bench compare replicas by TTFT/TPOT, and
+        # a client-side wall clock would fold proxy+network time into
+        # the very signal being compared.
         resp["kubeinfer"] = {
             "route": route,
             "ttft_ms": round(ttft * 1e3, 3),
+            "tpot_ms": round(tpot * 1e3, 3),
         }
+        resp["kubeinfer"].update(route_box.get("ext") or {})
         return resp
 
     def _observe_breakdown(self, route: str, total_s: float, n_out: int,
-                           req=None) -> float:
+                           req=None) -> tuple[float, float]:
         """Derived latency-breakdown histograms. The continuous route
         hands back its ``_Request`` (``timing`` in the route box) whose
         t_submit/t_admit/t_first/t_done were stamped by the scheduler
         itself; routes without an internal timeline degrade to
         end-to-end TTFT and mean-per-token TPOT — the route label keeps
         the populations separable on dashboards. Returns the observed
-        TTFT (seconds) so complete() can echo it to the client."""
+        ``(ttft, tpot)`` seconds so complete() can echo them to the
+        client (the disagg bench compares decode-replica TPOT tails
+        across fleet topologies from this echo)."""
         ttft = total_s
         decode_s = None
         if req is not None and req.t_submit:
@@ -649,7 +738,41 @@ class InferenceServer:
             tpot = total_s / max(1, n_out)
         self.metrics["tpot"].observe(route, tpot)
         self.slo.observe("tpot", tpot)
-        return ttft
+        return ttft, tpot
+
+    def _maybe_import_prefix(self, ids: list[int], base_url: str) -> None:
+        """Pull this prompt's exported KV prefix from ``base_url`` and
+        land it in the local pool + radix cache. Best-effort: every
+        failure increments a fallback reason and the request proceeds
+        with a local (token-identical) prefill. Runs lock-free on the
+        serving HTTP thread — the network fetch here is exactly the
+        blocking surface the admit path must never hold a lock across,
+        so it happens before routing, and the scatter itself is staged
+        to the scheduler thread (batching.import_prefix)."""
+        from kubeinfer_tpu.disagg.client import import_remote_prefix
+        from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+
+        eng = self.continuous
+        fps = prefix_fingerprints(ids, eng.block_size)
+        if not fps:
+            return  # sub-block prompt: nothing a prefill replica can ship
+        advertised = set(
+            eng.cache_summary().get("fingerprints", [])
+        )
+        if fps[-1] in advertised:
+            return  # already warm locally (earlier import or admit)
+        t0 = time.perf_counter()
+        imported, reason, wire_bytes = import_remote_prefix(
+            eng, ids, base_url,
+        )
+        if imported > 0:
+            self.metrics["kv_stream_blocks"].inc("import", by=imported)
+            self.metrics["kv_stream_bytes"].inc("import", by=wire_bytes)
+            self.metrics["kv_stream_seconds"].observe(
+                "import", time.perf_counter() - t0
+            )
+        else:
+            self.metrics["disagg_fallbacks"].inc(reason or "unknown")
 
     def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")
@@ -657,8 +780,10 @@ class InferenceServer:
             raise ValueError("'prompt' is required")
         ids = self._encode(prompt)
         max_tokens = int(body.get("max_tokens", 16))
-        if not (0 < max_tokens <= 4096):
-            raise ValueError("max_tokens must be in (0, 4096]")
+        if not (0 <= max_tokens <= 4096):
+            raise ValueError(
+                "max_tokens must be in [0, 4096] (0 = prefill-only)"
+            )
         temperature = float(body.get("temperature", 0.0))
         top_k = int(body.get("top_k", 0))
         top_p = float(body.get("top_p", 1.0))
@@ -674,7 +799,74 @@ class InferenceServer:
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
             eos_id = int(self.tokenizer.eos_token_id)
 
-        if self.sp is not None and self.sp.fits(len(ids), max_tokens):
+        # disaggregated decode side: the router annotates the forwarded
+        # body with the prefill replica that just produced this prompt's
+        # KV; pull it into the local pool BEFORE routing so the
+        # continuous admit below sees a warm radix cache. Runs on this
+        # HTTP thread with no engine locks held (the scatter is staged
+        # to the scheduler thread) — the new blocking surface the lint
+        # would flag lives in _maybe_import_prefix, off-lock by design.
+        kv_source = body.get("kubeinfer_kv_source")
+        if (
+            isinstance(kv_source, str) and kv_source
+            and max_tokens > 0
+            and self.continuous is not None
+            and self.continuous.fits(len(ids), max_tokens)
+        ):
+            self._maybe_import_prefix(ids, kv_source)
+
+        if max_tokens == 0:
+            # prefill-only mode (disaggregated prefill role): run the
+            # prompt through the continuous batcher's normal admit path
+            # — the SAME code that serves interleaved prefills, so the
+            # exported pages are bit-identical to what a local prefill
+            # would have produced — and park the wire-encoded KV in the
+            # export cache for a decode replica to pull. This branch
+            # outranks every other route: sp/speculative/engine have no
+            # exportable paged pool.
+            if not (
+                self.continuous is not None
+                and self.continuous.fits(len(ids), 0)
+            ):
+                raise ValueError(
+                    "max_tokens=0 (prefill-only) requires the continuous "
+                    "batcher and a prompt that fits its cache"
+                )
+            route_box["route"] = "prefill"
+            req = self.continuous.serve(
+                ids, max_new_tokens=0, eos_id=eos_id,
+                temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p,
+                repetition_penalty=rep_penalty,
+                export_kv=True,
+            )
+            gen: list[int] = []
+            route_box["timing"] = req
+            if req.kv_export is not None and self.kv_exports is not None:
+                from kubeinfer_tpu.disagg.wire import (
+                    WireError, encode_payload,
+                )
+
+                exp = req.kv_export
+                try:
+                    blob = encode_payload(
+                        exp["pages_k"], exp["pages_v"],
+                        exp["fingerprints"], exp["block_size"],
+                    )
+                except WireError:
+                    # capture raced an empty/partial prefill (e.g. the
+                    # prompt had no full block); the importer will fall
+                    # back to local prefill — latency, not correctness
+                    log.exception("kv export encode failed; skipping")
+                else:
+                    fp = exp["fingerprints"][-1]
+                    self.kv_exports.put(fp, blob)
+                    route_box["ext"] = {"kv_export": {
+                        "fingerprint": int(fp),
+                        "blocks": len(exp["fingerprints"]),
+                        "bytes": len(blob),
+                    }}
+        elif self.sp is not None and self.sp.fits(len(ids), max_tokens):
             # long prompts shard their prefill over the mesh's sp axis
             # (ring attention; sp_engine.py) and decode from the
             # handed-off KV — the route that makes >single-chip-prefill

@@ -30,6 +30,9 @@ __all__ = ["FlightEvent", "FlightRecorder"]
 KINDS = (
     "submit", "admit", "retire", "evict", "backpressure", "fail_inflight",
     "preempt", "resume", "chunk",
+    # disaggregated prefill/decode (disagg/): a remote prefix staged
+    # for scatter, landed in the pool, or rejected at validation
+    "import_staged", "import", "import_reject",
 )
 
 

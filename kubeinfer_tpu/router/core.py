@@ -86,6 +86,26 @@ def _router_metrics(registry: Registry) -> dict:
             "Known replicas by liveness at the last decision",
             labels=("state",), registry=registry,
         ),
+        # disaggregated prefill (second routing axis): prefill-role
+        # replicas never join the decode candidate set above — their
+        # decisions get their own counter so the prefill plane is
+        # observable separately from completion placement
+        "prefill_routed": Counter(
+            "kubeinfer_router_prefill_routed_total",
+            "Prefill-phase placements, by chosen prefill replica",
+            labels=("replica",), registry=registry,
+        ),
+        # same metric name as the inference server's fallback counter —
+        # different registry, same dashboard query: wherever the
+        # degradation happens (router can't reach the prefill tier,
+        # decode replica can't pull the blocks), the series reads as
+        # one family
+        "disagg_fallbacks": Counter(
+            "kubeinfer_disagg_fallbacks_total",
+            "Two-phase requests that degraded to single-phase routing "
+            "(interleaved local prefill), by reason",
+            labels=("reason",), registry=registry,
+        ),
     }
 
 
@@ -139,6 +159,13 @@ class FleetRouter:
         self.metrics = _router_metrics(self.registry)
         self._lock = make_lock("router.FleetRouter._lock")
         self._replicas: dict[str, ReplicaView] = {}
+        # prefill-role replicas (disaggregated prefill/decode): a
+        # SEPARATE pool so the decode scorer can never place a
+        # completion on a machine whose slots exist to absorb long
+        # prefills — the isolation IS the feature. Same ReplicaView
+        # shape (breakers, staleness) so polling and snapshots share
+        # code with the decode side.
+        self._prefill_replicas: dict[str, ReplicaView] = {}
         self._decisions = 0
         self._hits = 0
         guard(self)
@@ -166,6 +193,30 @@ class FleetRouter:
                 view.url = url.rstrip("/")
             return view
 
+    def add_prefill_replica(self, name: str, url: str) -> ReplicaView:
+        """Register a prefill-role replica (disaggregated prefill). It
+        receives ONLY max_tokens=0 prefill-phase requests — never
+        completions — and carries its own breaker so a dying prefill
+        tier degrades to interleaved local prefill without poisoning
+        decode routing. Names are shared with the decode pool in
+        update_replica, so a name must not appear in both."""
+        with self._lock:
+            view = self._prefill_replicas.get(name)
+            if view is None:
+                view = ReplicaView(
+                    name=name, url=url.rstrip("/"),
+                    breaker=CircuitBreaker(
+                        edge=f"router.prefill[{name}]",
+                        failure_threshold=self._breaker_threshold,
+                        reset_timeout_s=self._breaker_reset_s,
+                        clock=self._clock,
+                    ),
+                )
+                self._prefill_replicas[name] = view
+            else:
+                view.url = url.rstrip("/")
+            return view
+
     def update_replica(self, name: str, serving: dict | None,
                        age_s: float = 0.0) -> None:
         """Authoritative refresh from a ``/cache/summary`` body's
@@ -178,7 +229,8 @@ class FleetRouter:
         serving = serving if isinstance(serving, dict) else {}
         summary = serving.get("cache_summary")
         with self._lock:
-            view = self._replicas.get(name)
+            view = (self._replicas.get(name)
+                    or self._prefill_replicas.get(name))
             if view is None:
                 return
             view.serving = serving
@@ -224,6 +276,42 @@ class FleetRouter:
     def replicas(self) -> list[ReplicaView]:
         with self._lock:
             return list(self._replicas.values())
+
+    def prefill_replicas(self) -> list[ReplicaView]:
+        with self._lock:
+            return list(self._prefill_replicas.values())
+
+    def route_prefill(self, exclude: frozenset | set = frozenset()) -> ReplicaView:
+        """Pick a prefill replica for the max_tokens=0 phase. No
+        affinity axis: prefill output is exported by content address,
+        so ANY prefill replica produces the same blocks — the only
+        signal that matters is queue pressure (a prefill slot busy with
+        someone else's long prompt is the head-of-line blocking this
+        tier exists to absorb). Breaker gating uses peek() like the
+        decode scorer: the proxy's RetryPolicy consumes the half-open
+        probe, not candidacy. Ties break by name for replayability."""
+        with self._lock:
+            views = list(self._prefill_replicas.values())
+        best: ReplicaView | None = None
+        best_key: tuple[float, str] | None = None
+        for view in views:
+            if view.name in exclude:
+                self.metrics["skipped"].inc(view.name, "failed")
+                continue
+            if view.breaker is not None and not view.breaker.peek():
+                self.metrics["skipped"].inc(view.name, "breaker")
+                continue
+            key = (scoring.queue_pressure(view.serving), view.name)
+            if best_key is None or key < best_key:
+                best_key = key
+                best = view
+        if best is None:
+            raise NoReplicaError(
+                f"no routable prefill replica ({len(views)} known, "
+                f"{len(exclude)} excluded this request)"
+            )
+        self.metrics["prefill_routed"].inc(best.name)
+        return best
 
     # -- the decision -------------------------------------------------------
 

@@ -34,6 +34,16 @@ STALE_PENALTY_BLOCKS = 8.0
 STALE_AFTER_S = 10.0
 DEAD_AFTER_S = 30.0
 
+# Disaggregated prefill cutoff: prompts at least this long take the
+# two-phase route (prefill on a prefill-role replica, KV streamed to
+# the decode replica) when prefill replicas are configured. Short
+# prompts interleave fine — chunked prefill bounds their decode-batch
+# stall to one chunk — so shipping their KV would pay the wire cost
+# for prefills that were never the head-of-line problem. 256 tokens is
+# ~2x the default chunk (4 blocks x 32) — the point where a cold
+# prompt starts occupying multiple interleave rounds.
+DEFAULT_PREFILL_THRESHOLD_TOKENS = 256
+
 # Reconciler gate: a node whose serving replica is at least this many
 # queues-per-slot deep loses its cache-affinity pull in the placement
 # cost tensor (the solver's affinity channel is a bitmap, so the

@@ -17,6 +17,17 @@ One process, three loops:
 - ``/metrics`` renders the router's own registry (kubeinfer_router_*
   plus the shared retry/breaker series its RetryPolicy feeds).
 
+With ``--prefill-replica`` endpoints registered, long prompts take a
+TWO-PHASE route (disaggregated prefill/decode): phase one POSTs the
+prompt with ``max_tokens=0`` to a prefill-role replica, which exports
+the resulting KV blocks by content address; phase two is the normal
+decode placement, with the body annotated (``kubeinfer_kv_source``) so
+the chosen decode replica streams the blocks over /kv/blocks instead
+of recomputing the prefill. Every failure along the way — prefill tier
+down, export evicted, wire corruption — degrades to the single-phase
+route with its interleaved local prefill, which is token-identical by
+the determinism contract.
+
 The proxy retries only failures that prove the request never reached
 the replica (resilience.connect_failure): generation is deterministic
 per (prompt, seed, sampling), so a replay is token-identical, but a
@@ -60,10 +71,19 @@ class RouterServer:
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
                  port: int = 0, poll_interval_s: float = 2.0,
                  upstream_timeout_s: float = 300.0,
+                 prefill_threshold: int | None = None,
                  rng: random.Random | None = None) -> None:
+        from kubeinfer_tpu.router import scoring
+
         self.router = router
         self.poll_interval_s = poll_interval_s
         self.upstream_timeout_s = upstream_timeout_s
+        # disaggregated prefill cutoff: prompts at least this long take
+        # the two-phase route when prefill replicas are registered
+        self.prefill_threshold = (
+            prefill_threshold if prefill_threshold is not None
+            else scoring.DEFAULT_PREFILL_THRESHOLD_TOKENS
+        )
         # seeded-injectable rng: chaos runs replay the retry jitter
         self._rng = rng if rng is not None else random.Random()
         self._stop = threading.Event()
@@ -139,6 +159,26 @@ class RouterServer:
             prompt if isinstance(prompt, list)
             and all(isinstance(t, int) for t in prompt) else []
         )
+        # disaggregated two-phase route: long prompts prefill on a
+        # prefill-role replica first (max_tokens=0 — the replica
+        # exports the KV blocks by content address), then the decode
+        # placement below proceeds normally with the body annotated so
+        # the chosen decode replica pulls the blocks instead of
+        # recomputing. Every failure mode degrades to the single-phase
+        # route — interleaved local prefill, token-identical by the
+        # determinism contract — so this block can only add latency,
+        # never errors.
+        max_tokens = body.get("max_tokens", 16)
+        if (
+            tokens
+            and len(tokens) >= self.prefill_threshold
+            and isinstance(max_tokens, int) and max_tokens > 0
+            and self.router.prefill_replicas()
+        ):
+            kv_source = self._prefill_phase(tokens, body)
+            if kv_source is not None:
+                body["kubeinfer_kv_source"] = kv_source
+                raw_body = json.dumps(body).encode()
         tried: set[str] = set()
         while True:
             try:
@@ -167,6 +207,69 @@ class RouterServer:
             if tokens:
                 self.router.note_routed(decision, tokens)
             return 200, self._annotate(payload, decision)
+
+    def _prefill_phase(self, tokens: list[int],
+                       body: dict) -> str | None:
+        """Run the prefill phase of a two-phase request: POST the
+        prompt with ``max_tokens=0`` to a prefill-role replica so its
+        export cache holds this prefix's KV, and return that replica's
+        URL for the ``kubeinfer_kv_source`` annotation. Returns None
+        when the phase is skipped or failed — the caller proceeds
+        single-phase. Retries across prefill replicas like forward()
+        does across decode replicas; each attempt rides the replica's
+        own breaker, so a dead prefill tier trips open and subsequent
+        requests skip the phase at peek() cost."""
+        pre_body = dict(body)
+        pre_body["max_tokens"] = 0
+        pre_body.pop("kubeinfer_kv_source", None)
+        raw = json.dumps(pre_body).encode()
+        tried: set[str] = set()
+        while True:
+            try:
+                view = self.router.route_prefill(exclude=tried)
+            except NoReplicaError:
+                self.router.metrics["disagg_fallbacks"].inc(
+                    "prefill_unreachable"
+                )
+                return None
+
+            def attempt() -> bytes:
+                faultpoints.fire("router.prefill", key=view.name)
+                req = urllib.request.Request(
+                    view.url + "/v1/completions",
+                    data=raw,
+                    headers=inject_traceparent(
+                        {"Content-Type": "application/json"}
+                    ),
+                    method="POST",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.upstream_timeout_s
+                ) as resp:
+                    return resp.read()
+
+            try:
+                _PROXY_POLICY.call(
+                    attempt, edge="router.prefill",
+                    breaker=view.breaker, rng=self._rng,
+                )
+            except urllib.error.HTTPError:
+                # the replica ANSWERED with a verdict (e.g. the prompt
+                # exceeds its cache): another prefill replica of the
+                # same fleet would refuse identically, so skip the
+                # phase rather than spin
+                self.router.metrics["disagg_fallbacks"].inc(
+                    "prefill_rejected"
+                )
+                return None
+            except Exception as e:  # noqa: BLE001 — transport failure
+                log.warning(
+                    "prefill replica %s unreachable (%s); re-scoring",
+                    view.name, type(e).__name__,
+                )
+                tried.add(view.name)
+                continue
+            return view.url
 
     def _proxy(self, decision, raw_body: bytes) -> bytes:
         """One replica attempt under the per-replica retry policy and
@@ -224,7 +327,10 @@ class RouterServer:
         (aging) view — staleness scoring and the breaker handle them;
         the poller never unregisters anything."""
         ok = 0
-        for view in self.router.replicas():
+        # both roles refresh from the same endpoint: prefill replicas
+        # need fresh queue pressure for route_prefill, and their
+        # staleness/breaker bookkeeping shares the decode machinery
+        for view in self.router.replicas() + self.router.prefill_replicas():
             try:
                 with urllib.request.urlopen(
                     view.url + "/cache/summary", timeout=timeout_s
@@ -246,6 +352,7 @@ class RouterServer:
             {
                 "name": v.name,
                 "url": v.url,
+                "role": role,
                 "fingerprints": len(v.fingerprints),
                 "version": v.version,
                 "queue_depth": v.serving.get("queue_depth"),
@@ -255,7 +362,11 @@ class RouterServer:
                 ),
                 "breaker": v.breaker.state if v.breaker else "none",
             }
-            for v in self.router.replicas()
+            for role, views in (
+                ("decode", self.router.replicas()),
+                ("prefill", self.router.prefill_replicas()),
+            )
+            for v in views
         ]
 
     # -- lifecycle ----------------------------------------------------------
@@ -288,6 +399,16 @@ def main(argv: list[str] | None = None) -> int:
                    metavar="NAME=URL", required=True,
                    help="inference server endpoint, repeatable "
                         "(e.g. r0=http://10.0.0.5:8000)")
+    p.add_argument("--prefill-replica", action="append", default=[],
+                   metavar="NAME=URL",
+                   help="prefill-role endpoint, repeatable; long "
+                        "prompts prefill here first (max_tokens=0) and "
+                        "stream their KV blocks to the decode replica "
+                        "(disaggregated prefill/decode)")
+    p.add_argument("--prefill-threshold", type=int, default=None,
+                   help="minimum prompt tokens for the two-phase route "
+                        "(default: scoring."
+                        "DEFAULT_PREFILL_THRESHOLD_TOKENS)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--alpha", type=float,
@@ -308,12 +429,19 @@ def main(argv: list[str] | None = None) -> int:
         if not url:
             p.error(f"--replica needs NAME=URL, got {spec!r}")
         router.add_replica(name, url)
+    for spec in args.prefill_replica:
+        name, _, url = spec.partition("=")
+        if not url:
+            p.error(f"--prefill-replica needs NAME=URL, got {spec!r}")
+        router.add_prefill_replica(name, url)
     srv = RouterServer(router, host=args.host, port=args.port,
-                       poll_interval_s=args.poll_interval)
+                       poll_interval_s=args.poll_interval,
+                       prefill_threshold=args.prefill_threshold)
     srv.poll_once()
     srv.start()
-    log.info("router listening on :%d over %d replicas",
-             srv.port, len(router.replicas()))
+    log.info("router listening on :%d over %d decode + %d prefill "
+             "replicas", srv.port, len(router.replicas()),
+             len(router.prefill_replicas()))
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

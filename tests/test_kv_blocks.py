@@ -19,9 +19,11 @@ import pytest
 
 from kubeinfer_tpu.inference import PRESETS, init_params
 from kubeinfer_tpu.inference.kv_blocks import (
+    _FP_SEED,
     NULL_BLOCK,
     BlockPool,
     RadixCache,
+    extend_fingerprint,
     prefix_fingerprints,
 )
 
@@ -201,6 +203,45 @@ class TestRadixCache:
         assert set(prefix_fingerprints(toks + [99, 98], 4)) == adv
         diverged = prefix_fingerprints([0, 1, 2, 3, 7, 7, 7, 7], 4)
         assert diverged[0] in adv and diverged[1] not in adv
+
+    def test_extend_fingerprint_chains_to_prefix_fingerprints(self):
+        # the disagg wire content-addresses blocks with these values:
+        # both sides must agree that element i of the chain is the seed
+        # extended block-by-block through block i — a drift here would
+        # scatter a remote prefix under the wrong tokens
+        toks = [7, 1, 9, 3, 2, 8, 4, 6, 5, 0, 11, 13]
+        fps = prefix_fingerprints(toks, 4)
+        assert len(fps) == 3
+        fp = _FP_SEED
+        for i in range(3):
+            fp = extend_fingerprint(fp, toks[4 * i: 4 * i + 4])
+            assert fps[i] == fp
+        # the chain is positional, not a bag of blocks: swapping two
+        # blocks must change every fingerprint from the swap onward
+        swapped = toks[4:8] + toks[:4] + toks[8:]
+        fps_swapped = prefix_fingerprints(swapped, 4)
+        assert fps_swapped[0] != fps[0] and fps_swapped[2] != fps[2]
+        # the partial tail never fingerprints
+        assert prefix_fingerprints(toks[:7], 4) == fps[:1]
+
+    def test_match_with_fingerprints_pairs_blocks_and_chain(self):
+        # export-side walk: same refcount contract as match(), plus the
+        # per-node path fingerprint equal to what prefix_fingerprints
+        # recomputes from raw tokens (the wire's content addresses)
+        pool = BlockPool(num_blocks=16, block_size=4)
+        cache = RadixCache(pool)
+        toks = list(range(12))
+        blocks = self._cached(cache, pool, toks)
+        pairs = cache.match_with_fingerprints(toks)
+        assert [b for b, _ in pairs] == blocks
+        assert [fp for _, fp in pairs] == prefix_fingerprints(toks, 4)
+        assert [pool.refcount(b) for b, _ in pairs] == [2, 2, 2]
+        pool.unref([b for b, _ in pairs])
+        # divergent suffix: pairs stop at the shared prefix
+        pairs = cache.match_with_fingerprints([0, 1, 2, 3, 9, 9, 9, 9])
+        assert len(pairs) == 1 and pairs[0][0] == blocks[0]
+        assert pairs[0][1] == prefix_fingerprints(toks, 4)[0]
+        pool.unref([b for b, _ in pairs])
 
     def test_summary_version_bumps_on_insert_and_evict(self):
         pool = BlockPool(num_blocks=6, block_size=4)

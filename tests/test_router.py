@@ -448,6 +448,92 @@ class TestRouterChaos:
             ref.stop()
             stop_fleet(replicas, rs)
 
+    def test_prefill_replica_kill_midstream_is_token_lossless(
+            self, params):
+        """Disaggregated-prefill chaos: kill the prefill replica's
+        endpoint while two-phase traffic flows. Requests racing the
+        kill may die at ANY point of the transfer plane — prefill phase
+        unreachable, or prefill done but the export fetch failing on
+        the decode side — and every one must degrade to an interleaved
+        local prefill that is token-identical to the single-replica
+        reference. Degradation is visible (prefill breaker opens,
+        fallback counters move), correctness is not."""
+        replicas, router, rs = mk_fleet(params)
+        pre_srv, pre_cont = mk_replica(params, "p0")
+        router.add_prefill_replica("p0", f"http://127.0.0.1:{pre_srv.port}")
+        rs.prefill_threshold = 32  # every long prompt takes two-phase
+        rs.poll_once()
+        ref = ContinuousEngine(
+            params, TINY, n_slots=2, cache_len=128, block_size=BS,
+        ).start()
+        try:
+            fams = [list(range(1, 33)), list(range(100, 132))]
+            prompts = [f + [200 + i] for i, f in enumerate(fams * 6)]
+            expect = {
+                tuple(p): ref.generate(p, max_new_tokens=4, eos_id=-1)
+                for p in prompts
+            }
+            results: queue.Queue = queue.Queue()
+            work: queue.Queue = queue.Queue()
+
+            def client():
+                while True:
+                    try:
+                        p = work.get_nowait()
+                    except queue.Empty:
+                        return
+                    status, body = post(rs.port, {
+                        "prompt": p, "max_tokens": 4,
+                    })
+                    results.put((p, status, body))
+
+            for p in prompts[:4]:  # warm the plane: exports + imports
+                work.put(p)
+            client()
+            assert len(pre_srv.kv_exports) > 0  # two-phase engaged
+            for p in prompts[4:]:
+                work.put(p)
+            threads = [threading.Thread(target=client) for _ in range(3)]
+            for t in threads:
+                t.start()
+            # kill the prefill tier's endpoint while workers are mid-run
+            pre_srv.stop()
+            for t in threads:
+                t.join(timeout=300)
+            assert not any(t.is_alive() for t in threads)
+            seen = 0
+            while not results.empty():
+                p, status, body = results.get()
+                assert status == 200
+                assert body["choices"][0]["tokens"] == expect[tuple(p)], (
+                    f"tokens diverged for prompt {p[:4]}..."
+                )
+                seen += 1
+            assert seen == len(prompts)
+            # the prefill breaker opened and the two-phase route
+            # degraded through the fallback counter, not through errors
+            pview = {v.name: v for v in router.prefill_replicas()}
+            assert pview["p0"].breaker.state == "open"
+            fb = router.metrics["disagg_fallbacks"]
+            assert (
+                fb.value("prefill_unreachable")
+                + fb.value("prefill_rejected")
+            ) > 0
+            # decode replicas kept serving throughout
+            served = sum(
+                router.metrics["requests"].value(f"r{i}", "ok")
+                for i in range(2)
+            )
+            assert served == len(prompts)
+        finally:
+            ref.stop()
+            try:
+                pre_srv.stop()
+            except Exception:  # noqa: BLE001 — already chaos-killed
+                pass
+            pre_cont.stop()
+            stop_fleet(replicas, rs)
+
     def test_injected_proxy_fault_rescores(self, params):
         """router.proxy fault point: injected connection resets on one
         replica behave exactly like the real kill — excluded for the
