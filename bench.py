@@ -333,6 +333,32 @@ V5E_PEAK_BF16_FLOPS = 197e12
 V5E_HBM_BYTES_PER_S = 819e9
 
 
+def _kv_read_bytes_per_token(cfg, live_len, kv_dtype="bf16",
+                             block_size=None):
+    """Per-token KV stream for the decode roofline, dtype-aware: pages
+    at the pool dtype's width, plus — under int8 — the per-block scale
+    gather (one f32 per live block per kv head per layer, k and v
+    each). The scale term is tiny next to the pages (4 bytes per BLOCK
+    per head vs bytes-per-token per head), but the published fraction
+    must account for every stream the quantized step issues or the
+    int8 roofline would claim exactly 2x when it delivers slightly
+    less."""
+    from kubeinfer_tpu.inference.batching import DEFAULT_BLOCK_SIZE
+
+    elem = 1.0 if kv_dtype == "int8" else 2.0
+    n = (
+        2.0 * cfg.num_hidden_layers * live_len
+        * cfg.num_key_value_heads * cfg.head_dim * elem
+    )
+    if kv_dtype == "int8":
+        bs = block_size if block_size else DEFAULT_BLOCK_SIZE
+        n += (
+            2.0 * cfg.num_hidden_layers * float(np.ceil(live_len / bs))
+            * cfg.num_key_value_heads * 4.0
+        )
+    return n
+
+
 def inference_bench(short_new=8, long_new=128, prompt_len=512,
                     long_prompt_len=2048, model="bench-280m"):
     """Native-engine serving throughput on the live device — BOTH phases.
@@ -402,10 +428,7 @@ def inference_bench(short_new=8, long_new=128, prompt_len=512,
     # the differenced window, since the live length grows one slot per
     # step between short_new and long_new)
     live_len = prompt_len + (short_new + long_new) / 2.0
-    kv_read_bytes = (
-        2.0 * cfg.num_hidden_layers * live_len
-        * cfg.num_key_value_heads * cfg.head_dim * 2.0
-    )
+    kv_read_bytes = _kv_read_bytes_per_token(cfg, live_len)
     # the serving engine resolves KV through per-row block tables
     # (batching paged pool): each layer's decode kernel additionally
     # prefetches the row's live i32 table entries. Folded in so the
@@ -1070,6 +1093,141 @@ def speculative_decode_bench(short_new=8, long_new=104, prompt_len=32,
         ),
         "spec_dispatches_per_token": round(ratio_spec, 4),
     }
+
+
+def kv_quant_bench(short_new=8, long_new=72, prompt_len=32,
+                   n_slots=32, cache_len=256, cap_cache_len=4096,
+                   model="tiny", reps=3):
+    """Quantized-KV phase (int8 pool PR): capacity and throughput of
+    the int8 block pool against the bf16 pool it replaces.
+
+    Capacity is the headline: ``max_concurrent_slots`` divides a fixed
+    1 GiB per-device KV budget by each engine's MEASURED per-slot pool
+    bytes (pages + quant scales + the per-slot bf16 tail buffers, from
+    the arrays' own nbytes — not a formula that could drift from the
+    allocation). The ratio gate wants >= 1.8x, not 2.0x: scales and
+    tails are real bytes the int8 pool carries that bf16 does not, and
+    the capacity figure must charge for them. Sized at a serving-shape
+    cache (cap_cache_len) because the tail overhead is FIXED per slot
+    (two blocks) — at toy cache lengths it eats the win and the figure
+    would misrepresent the deployment it models.
+
+    Throughput reuses the decode_window_bench chain-differencing on
+    identical B=32 workloads per dtype — on the CPU fallback this
+    brackets the dequant-gather overhead rather than the HBM win (the
+    bandwidth story lives in the roofline model,
+    _kv_read_bytes_per_token). The same runs feed the accuracy gates:
+    greedy token match fraction int8-vs-bf16, and the max abs dequant
+    error measured by round-tripping the bf16 engine's OWN committed
+    pages through quantize/dequantize — real KV data, not synthetic.
+    The match fraction understates trained-model parity: random bf16
+    weights put near-ties (~3e-4 logit gaps) everywhere, a sub-err
+    perturbation flips them, and one flip diverges the row's whole
+    suffix — the per-position identity gate on separated logits lives
+    in tests/test_kv_quant.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.inference.kv_blocks import (
+        dequantize_blocks, quantize_blocks,
+    )
+
+    cfg = PRESETS[model]
+    # bf16 params so the baseline pool really is bf16: init_params
+    # defaults to f32 on CPU, which would flatter the capacity ratio
+    # to ~4x and misstate the gate this phase exists to check
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_slots)
+    ]
+    steps = n_slots * (long_new - short_new)
+    out = {}
+
+    # --- capacity at the serving shape: measured bytes, no dispatch ---
+    budget = float(1 << 30)
+    for d in ("bf16", "int8"):
+        eng = ContinuousEngine(
+            params, cfg, n_slots=8, cache_len=cap_cache_len, kv_dtype=d,
+        )
+        per_slot = eng.kv_pool_bytes / 8.0
+        out[f"max_concurrent_slots_{d}"] = int(budget // per_slot)  # lint: allow[host-sync] capacity math on measured pool nbytes, nothing timed here
+        del eng
+    out["kv_quant_capacity_ratio"] = round(
+        out["max_concurrent_slots_int8"]
+        / max(out["max_concurrent_slots_bf16"], 1), 3
+    )
+
+    # --- throughput + parity on identical greedy workloads ---
+    def _phase(d):
+        # block_size=16 (not the kernel-aligned 128): at these decode
+        # lengths a 128-wide block would never fill, so quantize-on-
+        # commit — the cost this phase exists to bracket — would sit
+        # outside the differenced window entirely
+        eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            block_size=16, kv_dtype=d,
+        ).start()
+        try:
+            def _run(max_new):
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts
+                ]
+                for r in reqs:
+                    if not r.done.wait(timeout=300):
+                        raise TimeoutError("quant-phase request hung")
+                return time.perf_counter() - t0, [
+                    list(r.out_tokens) for r in reqs
+                ]
+
+            _run(short_new)  # compile both shapes
+            _run(long_new)
+            _touch_progress()
+            shorts, longs = [], []
+            toks = None
+            for _ in range(reps):
+                shorts.append(_run(short_new)[0])
+                t, toks = _run(long_new)
+                longs.append(t)
+                _touch_progress()
+            dt = max(
+                statistics.median(longs) - statistics.median(shorts),
+                1e-9,
+            )
+            err = 0.0
+            if d == "bf16":
+                # round-trip the engine's own committed pages: the max
+                # abs dequant error on exactly the tensors the int8
+                # pool would have held for this workload
+                for pool in (*eng._state.caches_k, *eng._state.caches_v):
+                    q, s = quantize_blocks(pool)
+                    deq = dequantize_blocks(q, s, dtype=jnp.float32)
+                    err = max(err, float(jnp.max(jnp.abs(  # lint: allow[host-sync] error readback after eng.stop(): the timed window already closed
+                        deq - pool.astype(jnp.float32)
+                    ))))
+        finally:
+            eng.stop()
+        return steps / dt, toks, err
+
+    tps_bf16, toks_bf16, max_err = _phase("bf16")
+    tps_int8, toks_int8, _ = _phase("int8")
+    match = sum(
+        a == b for ta, tb in zip(toks_bf16, toks_int8)
+        for a, b in zip(ta, tb)
+    )
+    total = sum(len(t) for t in toks_bf16)
+    out.update({
+        "decode_tokens_per_sec_b32_bf16": round(tps_bf16, 1),
+        "decode_tokens_per_sec_b32_int8": round(tps_int8, 1),
+        "kv_quant_max_abs_err": round(max_err, 6),
+        "kv_quant_greedy_match_frac": round(match / max(total, 1), 4),
+    })
+    return out
 
 
 def _sharded_serving_child_main() -> int:
@@ -2114,6 +2272,24 @@ def main() -> None:
                 extras[key] = sp[key]
         except Exception as e:
             extras["speculative_decode_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # quantized-KV phase (int8 pool PR): measured per-slot pool
+        # bytes -> slot capacity at a 1 GiB budget (the >=1.8x gate),
+        # B=32 decode throughput per dtype bracketing the dequant +
+        # quantize-on-commit overhead, and the greedy-parity/max-err
+        # accuracy evidence
+        try:
+            kq = kv_quant_bench()
+            for key in (
+                "max_concurrent_slots_bf16", "max_concurrent_slots_int8",
+                "kv_quant_capacity_ratio",
+                "decode_tokens_per_sec_b32_bf16",
+                "decode_tokens_per_sec_b32_int8",
+                "kv_quant_max_abs_err", "kv_quant_greedy_match_frac",
+            ):
+                extras[key] = kq[key]
+        except Exception as e:
+            extras["kv_quant_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
         # fleet-routing phase (prefix-cache-aware router PR): p50 TTFT
         # through the summary-scoring router vs cache-blind round-robin

@@ -1010,6 +1010,217 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_quant_commit(fz: SchedFuzzer):
+    """Quantize-on-commit (int8 KV pool) racing LRU eviction, a
+    preemption park, a disagg export capture, and the stop sweep —
+    over the REAL RadixCache + BlockPool, not a model of them.
+
+    The dtype discipline is the thing under test: under int8 a slot's
+    newest block is a bf16 TAIL (held in the stepper's per-slot buffer;
+    its pool page is junk until the window-boundary commit quantizes
+    it), and the engine's contract is that only committed-quantized
+    blocks ever become SHAREABLE — radix.insert at retire/park and the
+    export capture both read pool pages, so a tail reaching either
+    would ship junk bytes under a valid fingerprint. Every share site
+    here funnels through insert_committed()/exporter(), which assert
+    exactly that. The content oracle from engine-kv-import rides
+    along: a block's tag is written only by its allocator, and the
+    boundary commit re-tags under the lock — so eviction freeing a
+    block a slot still holds, or a commit landing on a reallocated id,
+    trips a stability check in whichever thread owns the block now.
+    Under every schedule: one terminal state per request, no tail
+    block in the trie or in an export, refs drain to zero.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+
+    BS = 4
+    pool = BlockPool(32, BS)
+    radix = RadixCache(pool)
+    lock = make_lock("schedfuzz.engine-quant-commit._lock")
+    pending: list[int] = []
+    slots: dict[int, dict] = {}
+    served: list[int] = []
+    failed: list[int] = []
+    exports: list[int] = []
+    state = {"stopped": False}
+
+    def toks(rid: int) -> list[int]:
+        # two prefix families so admits, parks, and evictions collide
+        # on shared trie paths; 3 blocks = 2 committed + 1 tail at birth
+        return [100 * (rid % 2) + t for t in range(3 * BS)]
+
+    contents: dict[int, tuple] = {}
+    # per-block dtype state: "q" = committed-quantized pool page,
+    # "tail" = junk page whose real bytes live in the slot's bf16 tail
+    qstate: dict[int, str] = {}
+
+    def alloc_tagged(n: int, tag) -> list[int] | None:
+        if not radix.ensure_free(n):
+            return None
+        blocks = pool.alloc(n)
+        contents.update((b, (tag, i)) for i, b in enumerate(blocks))
+        qstate.update((b, "q") for b in blocks)
+        return blocks
+
+    def insert_committed(tokens: list[int], blocks: list[int]) -> None:
+        # THE invariant: nothing partial ever becomes shareable
+        assert all(qstate[b] == "q" for b in blocks), (
+            [qstate[b] for b in blocks]
+        )
+        radix.insert(tokens, blocks)
+
+    def scheduler() -> None:
+        for _ in range(12):
+            # admit: longest-prefix match, alloc the rest; prefill
+            # quantizes the full blocks it writes (qstate "q" at alloc)
+            # but the last block is the slot's live TAIL
+            with lock:
+                if state["stopped"]:
+                    return
+                if pending:
+                    rid = pending.pop(0)
+                    matched = radix.match(toks(rid))
+                    assert all(qstate[b] == "q" for b in matched)
+                    sig = [contents[b] for b in matched]
+                    need = 3 - len(matched)
+                    extra = alloc_tagged(need, ("adm", rid))
+                    if extra is None:
+                        pool.unref(matched)
+                        failed.append(rid)
+                    else:
+                        if extra:
+                            qstate[extra[-1]] = "tail"
+                        slots[rid] = {
+                            "blocks": matched + extra, "sig": sig,
+                            "tail": bool(extra),
+                        }
+            # window boundary: commit every live tail — quantize writes
+            # the pool page (re-tag models the byte write; a commit on
+            # a freed-and-reallocated id corrupts the new owner's tag
+            # and ITS stability check trips)
+            with lock:
+                if state["stopped"]:
+                    return
+                for rid, row in slots.items():
+                    if row["tail"]:
+                        b = row["blocks"][-1]
+                        assert qstate[b] == "tail", qstate[b]
+                        contents[b] = ("com", rid)
+                        qstate[b] = "q"
+                        row["tail"] = False
+            # retire: stability check on the matched prefix, cache the
+            # now-fully-committed row, release the slot refs
+            drain = None
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    rid = next(iter(slots))
+                    row = slots.pop(rid)
+                    n_sig = len(row["sig"])
+                    got = [contents[b] for b in row["blocks"][:n_sig]]
+                    assert got == row["sig"], (rid, got, row["sig"])
+                    insert_committed(toks(rid), row["blocks"])
+                    drain = (rid, row["blocks"])
+            if drain is not None:
+                pool.unref(drain[1])
+                with lock:
+                    served.append(drain[0])
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                if state["stopped"]:
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def parker() -> None:
+        # park drops the uncommitted tail on the floor (production:
+        # _park_slot caches committed = toks[:-1] only) — the trie gets
+        # the quantized prefix, never the tail block
+        for _ in range(3):
+            parked = None
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    rid = next(iter(slots))
+                    row = slots.pop(rid)
+                    keep = (
+                        row["blocks"][:-1] if row["tail"]
+                        else row["blocks"]
+                    )
+                    insert_committed(toks(rid)[: len(keep) * BS], keep)
+                    parked = (rid, row["blocks"])
+            if parked is None:
+                continue
+            pool.unref(parked[1])
+            with lock:
+                if state["stopped"]:
+                    failed.append(parked[0])
+                else:
+                    pending.append(parked[0])
+
+    def exporter() -> None:
+        # disagg export capture: reads the committed prefix under the
+        # lock (production np.stacks the pages there) — asserting the
+        # tail never rides along is the wire half of the invariant
+        for _ in range(4):
+            with lock:
+                if state["stopped"]:
+                    return
+                if slots:
+                    row = next(iter(slots.values()))
+                    cap = (
+                        row["blocks"][:-1] if row["tail"]
+                        else row["blocks"]
+                    )
+                    assert all(qstate[b] == "q" for b in cap), (
+                        [qstate[b] for b in cap]
+                    )
+                    exports.append(len(cap))
+
+    def evictor() -> None:
+        for _ in range(3):
+            radix.ensure_free(8)
+            with lock:
+                pass
+
+    def stopper() -> None:
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            leftover = pending[:]
+            pending.clear()
+            live = [(rid, row["blocks"]) for rid, row in slots.items()]
+            slots.clear()
+        for rid, blocks in live:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("sched", scheduler)
+    fz.spawn("park", parker)
+    fz.spawn("export", exporter)
+    fz.spawn("evict", evictor)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not pending and not slots, (pending, slots)
+        assert sorted(served + failed) == list(range(6)), (served, failed)
+        assert radix.ensure_free(31), pool.used_blocks
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -1023,6 +1234,7 @@ SCENARIOS = [
     Scenario("engine-sharded-window", _scn_engine_sharded_window),
     Scenario("engine-spec-rollback", _scn_engine_spec_rollback),
     Scenario("engine-kv-import", _scn_engine_kv_import),
+    Scenario("engine-quant-commit", _scn_engine_quant_commit),
 ]
 
 

@@ -99,9 +99,18 @@ def import_remote_prefix(
     # LRU would otherwise scatter someone else's KV under our tokens.
     if payload.block_size != bs or list(payload.fingerprints) != fps:
         return 0, "fingerprint_mismatch", payload.byte_size
+    # Dtype agreement is policy, not corruption: a v1 (bf16) blob from
+    # a pre-quantization prefill replica is perfectly valid bytes that
+    # an int8 pool cannot scatter — and vice versa. Declining here (not
+    # in wire.py) keeps mixed fleets observable via the fallback
+    # counter instead of masquerading as wire errors during rollout.
+    if payload.kv_dtype != getattr(engine, "kv_dtype", "bf16"):
+        return 0, "kv_dtype_mismatch", payload.byte_size
     imported, reason = engine.import_prefix(
         tokens[: len(fps) * bs],
         payload.pages_k, payload.pages_v,
         timeout_s=timeout_s,
+        scales_k=payload.scales_k, scales_v=payload.scales_v,
+        kv_dtype=payload.kv_dtype,
     )
     return imported, reason, payload.byte_size

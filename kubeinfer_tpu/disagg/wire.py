@@ -16,6 +16,16 @@ ends; position in the array IS the logical index. No tensor-parallel
 metadata either: pages are whole along every axis (the exporter
 gathers replicated logical blocks, the importer scatters into its own
 layout), per the package's layout audit.
+
+Version 2 (``kubeinfer-kvwire/2``) carries quantized pools: the body
+grows two ``[layers, blocks, n_kv]`` float32 scale arrays (K then V)
+after the pages, and the header names the pool's ``kv_dtype``. bf16
+exporters keep emitting v1 byte-identically — the new magic appears on
+the wire only when scales do, so a pre-quantization fleet never sees
+an unknown header field mid-rollout. Decoders accept both versions;
+dtype agreement is the IMPORTER's policy call (client.py), not a wire
+error: a v1 blob is a valid payload that an int8 engine must decline,
+not corruption.
 """
 
 from __future__ import annotations
@@ -27,6 +37,7 @@ import json
 import numpy as np
 
 _MAGIC = "kubeinfer-kvwire/1"
+_MAGIC_V2 = "kubeinfer-kvwire/2"
 
 # Header stays a bounded parse even against a hostile peer: fingerprint
 # lists are capped by pool size in practice (blocks <= num_blocks), but
@@ -48,6 +59,11 @@ class KVBlockPayload:
     pages_v: np.ndarray
     fingerprints: tuple[int, ...]
     block_size: int
+    # v2 fields: kv_dtype is the exporter's pool dtype ("bf16"/"int8");
+    # scales are [layers, blocks, n_kv] float32, present iff int8.
+    kv_dtype: str = "bf16"
+    scales_k: np.ndarray | None = None
+    scales_v: np.ndarray | None = None
 
     @property
     def blocks(self) -> int:
@@ -55,7 +71,10 @@ class KVBlockPayload:
 
     @property
     def byte_size(self) -> int:
-        return self.pages_k.nbytes + self.pages_v.nbytes
+        n = self.pages_k.nbytes + self.pages_v.nbytes
+        if self.scales_k is not None:
+            n += self.scales_k.nbytes + self.scales_v.nbytes
+        return n
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -79,6 +98,9 @@ def encode_payload(
     pages_v: np.ndarray,
     fingerprints: list[int] | tuple[int, ...],
     block_size: int,
+    scales_k: np.ndarray | None = None,
+    scales_v: np.ndarray | None = None,
+    kv_dtype: str = "bf16",
 ) -> bytes:
     if pages_k.shape != pages_v.shape or pages_k.dtype != pages_v.dtype:
         raise WireError(
@@ -95,6 +117,13 @@ def encode_payload(
             f"{len(fingerprints)} fingerprints for "
             f"{pages_k.shape[1]} blocks"
         )
+    if (scales_k is None) != (scales_v is None):
+        raise WireError("scales_k/scales_v must travel together")
+    if (kv_dtype != "bf16") != (scales_k is not None):
+        raise WireError(
+            f"kv_dtype {kv_dtype!r} inconsistent with "
+            f"scales {'present' if scales_k is not None else 'absent'}"
+        )
     pages_k = np.ascontiguousarray(pages_k)
     pages_v = np.ascontiguousarray(pages_v)
     body = pages_k.tobytes() + pages_v.tobytes()
@@ -106,9 +135,25 @@ def encode_payload(
         "page_shape": [int(d) for d in pages_k.shape[2:]],
         "block_size": int(block_size),
         "fingerprints": [int(fp) for fp in fingerprints],
-        "body_bytes": len(body),
-        "body_sha256": hashlib.sha256(body).hexdigest(),
     }
+    if scales_k is not None:
+        # Scale shape is derivable ([layers, blocks, n_kv]) but checked
+        # here so a malformed export fails at the producer, where the
+        # engine state is still inspectable, not at a remote importer.
+        want = (pages_k.shape[0], pages_k.shape[1], pages_k.shape[3])
+        for name, s in (("scales_k", scales_k), ("scales_v", scales_v)):
+            if tuple(s.shape) != want or s.dtype != np.float32:
+                raise WireError(
+                    f"{name} must be float32 {want}, got "
+                    f"{s.dtype} {tuple(s.shape)}"
+                )
+        scales_k = np.ascontiguousarray(scales_k)
+        scales_v = np.ascontiguousarray(scales_v)
+        body += scales_k.tobytes() + scales_v.tobytes()
+        header["magic"] = _MAGIC_V2
+        header["kv_dtype"] = kv_dtype
+    header["body_bytes"] = len(body)
+    header["body_sha256"] = hashlib.sha256(body).hexdigest()
     return json.dumps(header).encode() + b"\n" + body
 
 
@@ -120,10 +165,12 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
         header = json.loads(blob[:nl])
     except ValueError as e:
         raise WireError(f"header is not JSON: {e}") from e
-    if not isinstance(header, dict) or header.get("magic") != _MAGIC:
-        raise WireError(f"bad magic {header.get('magic')!r}"
-                        if isinstance(header, dict)
-                        else "header is not an object")
+    if not isinstance(header, dict):
+        raise WireError("header is not an object")
+    magic = header.get("magic")
+    if magic not in (_MAGIC, _MAGIC_V2):
+        raise WireError(f"bad magic {magic!r}")
+    v2 = magic == _MAGIC_V2
     body = blob[nl + 1:]
     try:
         layers = int(header["layers"])
@@ -134,8 +181,11 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
         body_bytes = int(header["body_bytes"])
         want_sha = str(header["body_sha256"])
         dtype = _resolve_dtype(str(header["dtype"]))
+        kv_dtype = str(header["kv_dtype"]) if v2 else "bf16"
     except (KeyError, TypeError, ValueError) as e:
         raise WireError(f"malformed header: {e}") from e
+    if v2 and kv_dtype == "bf16":
+        raise WireError("v2 header claims bf16 — scales make no sense")
     if len(page_shape) != 3 or page_shape[0] != block_size:
         raise WireError(
             f"page_shape {page_shape} inconsistent with "
@@ -156,15 +206,27 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
             f"want {want_sha[:12]}…)"
         )
     per_side = layers * blocks * int(np.prod(page_shape)) * dtype.itemsize
-    if len(body) != 2 * per_side:
+    n_kv = page_shape[1]
+    per_scale = layers * blocks * n_kv * 4 if v2 else 0
+    if len(body) != 2 * per_side + 2 * per_scale:
         raise WireError(
             f"body is {len(body)} bytes, header shapes imply "
-            f"{2 * per_side}"
+            f"{2 * per_side + 2 * per_scale}"
         )
     shape = (layers, blocks) + page_shape
     pages_k = np.frombuffer(body[:per_side], dtype=dtype).reshape(shape)
-    pages_v = np.frombuffer(body[per_side:], dtype=dtype).reshape(shape)
+    pages_v = np.frombuffer(
+        body[per_side:2 * per_side], dtype=dtype).reshape(shape)
+    scales_k = scales_v = None
+    if v2:
+        sshape = (layers, blocks, n_kv)
+        off = 2 * per_side
+        scales_k = np.frombuffer(
+            body[off:off + per_scale], dtype=np.float32).reshape(sshape)
+        scales_v = np.frombuffer(
+            body[off + per_scale:], dtype=np.float32).reshape(sshape)
     return KVBlockPayload(
         pages_k=pages_k, pages_v=pages_v,
         fingerprints=fingerprints, block_size=block_size,
+        kv_dtype=kv_dtype, scales_k=scales_k, scales_v=scales_v,
     )

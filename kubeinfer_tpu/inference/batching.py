@@ -51,7 +51,12 @@ import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.engine import _bucket, record_seen
-from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+from kubeinfer_tpu.inference.kv_blocks import (
+    BlockPool,
+    RadixCache,
+    dequantize_blocks,
+    quantize_blocks,
+)
 from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 from kubeinfer_tpu.inference.model import Params, forward
 from kubeinfer_tpu.observability import tracing
@@ -141,13 +146,37 @@ def _admit_slot(
         (cache_pos[None, None, :] <= q_pos[None, :, None])
         & (cache_pos[None, None, :] < prompt_len)
     )
-    caches = [
-        (
-            ck[table_row].reshape(1, S, n_kv, D),
-            cv[table_row].reshape(1, S, n_kv, D),
-        )
-        for ck, cv in zip(state.caches_k, state.caches_v)
-    ]
+    quantized = state.caches_k[0].dtype == jnp.int8
+    if quantized:
+        # quantized pool: the gathered view dequantizes committed
+        # blocks (shared-prefix KV arrives approximate — that IS the
+        # int8 contract); the suffix window recomputes in bf16, and
+        # requantizing a block whose values came from dequantization
+        # is exact (the amax element always quantizes to ±127, so the
+        # recovered scale round-trips)
+        dt = state.tails_k[0].dtype
+        caches = [
+            (
+                dequantize_blocks(
+                    ck[table_row], sk[table_row], dt
+                ).reshape(1, S, n_kv, D),
+                dequantize_blocks(
+                    cv[table_row], sv[table_row], dt
+                ).reshape(1, S, n_kv, D),
+            )
+            for ck, sk, cv, sv in zip(
+                state.caches_k, state.scales_k,
+                state.caches_v, state.scales_v,
+            )
+        ]
+    else:
+        caches = [
+            (
+                ck[table_row].reshape(1, S, n_kv, D),
+                cv[table_row].reshape(1, S, n_kv, D),
+            )
+            for ck, cv in zip(state.caches_k, state.caches_v)
+        ]
     logits, caches = forward(
         params, suffix, cfg, positions=q_pos[None, :], attn_mask=mask,
         kv_caches=caches, cache_offset=start,
@@ -168,10 +197,64 @@ def _admit_slot(
             jnp.where(own, new_blocks, pool[table_row])
         )
 
+    if quantized:
+        # quantize-on-commit: only owned FULL blocks (< prompt_len //
+        # bs) enter the pool; the partial tail block stays bf16 in the
+        # slot's tail pair until a decode window fills it
+        # (stepper._commit_full_tails) — a partial block never
+        # round-trips through int8
+        tb = prompt_len // bs
+        own_q = own_mask & (jnp.arange(M) < tb)
+
+        def putq(pool, scales, view):
+            blocks = view.reshape(M, bs, n_kv, D)
+            qv, sv = quantize_blocks(blocks)
+            pool = pool.at[table_row].set(
+                jnp.where(own_q[:, None, None, None], qv,
+                          pool[table_row])
+            )
+            scales = scales.at[table_row].set(
+                jnp.where(own_q[:, None], sv, scales[table_row])
+            )
+            return pool, scales
+
+        def tail_pair(tails, view):
+            blocks = view.reshape(M, bs, n_kv, D)
+            # slot 0 = the current partial block tb (clipped gather:
+            # tb == M only for prefill-only full rows, which never
+            # decode); slot 1 = zeroed spill room
+            t0 = blocks[jnp.clip(tb, 0, M - 1)]
+            return tails.at[slot].set(
+                jnp.stack([t0, jnp.zeros_like(t0)])
+            )
+
+        qk = [putq(b, s, c[0]) for b, s, c in zip(
+            state.caches_k, state.scales_k, caches)]
+        qv_ = [putq(b, s, c[1]) for b, s, c in zip(
+            state.caches_v, state.scales_v, caches)]
+        kv_fields = dict(
+            caches_k=[p for p, _ in qk],
+            scales_k=[s for _, s in qk],
+            caches_v=[p for p, _ in qv_],
+            scales_v=[s for _, s in qv_],
+            tails_k=[tail_pair(t, c[0]) for t, c in zip(
+                state.tails_k, caches)],
+            tails_v=[tail_pair(t, c[1]) for t, c in zip(
+                state.tails_v, caches)],
+        )
+    else:
+        kv_fields = dict(
+            caches_k=[
+                put(b, c[0]) for b, c in zip(state.caches_k, caches)
+            ],
+            caches_v=[
+                put(b, c[1]) for b, c in zip(state.caches_v, caches)
+            ],
+        )
+
     return dataclasses.replace(
         state,
-        caches_k=[put(b, c[0]) for b, c in zip(state.caches_k, caches)],
-        caches_v=[put(b, c[1]) for b, c in zip(state.caches_v, caches)],
+        **kv_fields,
         tables=state.tables.at[slot].set(table_row),
         last_token=state.last_token.at[slot].set(first),
         offset=state.offset.at[slot].set(prompt_len),
@@ -222,13 +305,31 @@ def _prefill_chunk(
     q_pos = pos + jnp.arange(T)
     cache_pos = jnp.arange(S)
     mask = cache_pos[None, None, :] <= q_pos[None, :, None]
-    caches = [
-        (
-            ck[table_row].reshape(1, S, n_kv, D),
-            cv[table_row].reshape(1, S, n_kv, D),
-        )
-        for ck, cv in zip(state.caches_k, state.caches_v)
-    ]
+    quantized = state.caches_k[0].dtype == jnp.int8
+    if quantized:
+        dt = state.tails_k[0].dtype
+        caches = [
+            (
+                dequantize_blocks(
+                    ck[table_row], sk[table_row], dt
+                ).reshape(1, S, n_kv, D),
+                dequantize_blocks(
+                    cv[table_row], sv[table_row], dt
+                ).reshape(1, S, n_kv, D),
+            )
+            for ck, sk, cv, sv in zip(
+                state.caches_k, state.scales_k,
+                state.caches_v, state.scales_v,
+            )
+        ]
+    else:
+        caches = [
+            (
+                ck[table_row].reshape(1, S, n_kv, D),
+                cv[table_row].reshape(1, S, n_kv, D),
+            )
+            for ck, cv in zip(state.caches_k, state.caches_v)
+        ]
     _, caches = forward(
         params, window, cfg, positions=q_pos[None, :], attn_mask=mask,
         kv_caches=caches, cache_offset=pos, return_hidden=True,
@@ -240,6 +341,36 @@ def _prefill_chunk(
         new_blocks = view.reshape(M, bs, n_kv, D)
         return pool.at[table_row].set(
             jnp.where(own, new_blocks, pool[table_row])
+        )
+
+    if quantized:
+        # intermediate chunks are block-aligned and entirely inside the
+        # prompt, so every owned block the window covered is FULL —
+        # quantize all owned blocks (blocks past the chunk hold junk
+        # that later chunks and the finalizing _admit_slot rewrite;
+        # already-committed earlier-chunk blocks requantize exactly,
+        # see _admit_slot)
+        def putq(pool, scales, view):
+            blocks = view.reshape(M, bs, n_kv, D)
+            qv, sv = quantize_blocks(blocks)
+            pool = pool.at[table_row].set(
+                jnp.where(own, qv, pool[table_row])
+            )
+            scales = scales.at[table_row].set(
+                jnp.where(own_mask[:, None], sv, scales[table_row])
+            )
+            return pool, scales
+
+        qk = [putq(b, s, c[0]) for b, s, c in zip(
+            state.caches_k, state.scales_k, caches)]
+        qv_ = [putq(b, s, c[1]) for b, s, c in zip(
+            state.caches_v, state.scales_v, caches)]
+        return dataclasses.replace(
+            state,
+            caches_k=[p for p, _ in qk],
+            scales_k=[s for _, s in qk],
+            caches_v=[p for p, _ in qv_],
+            scales_v=[s for _, s in qv_],
         )
 
     return dataclasses.replace(
@@ -319,6 +450,8 @@ def _import_blocks(
     own_mask: jax.Array,  # bool[max_blocks] True = real imported page
     pages_k: jax.Array,  # [L, max_blocks, bs, n_kv, D], zero-padded
     pages_v: jax.Array,
+    scales_k: jax.Array,  # f32[L, max_blocks, n_kv]; all-ones for bf16
+    scales_v: jax.Array,
 ) -> SlotState:
     """Scatter fetched KV pages into the pool (disaggregated prefill:
     a prefill replica computed them, wire.py carried them, the host
@@ -338,6 +471,27 @@ def _import_blocks(
             jnp.where(own, pages, pool[table_row])
         )
 
+    def put_s(scales, pages):
+        return scales.at[table_row].set(
+            jnp.where(own_mask[:, None], pages, scales[table_row])
+        )
+
+    # quantized pools also land the per-block scales (the exporter
+    # captured committed int8 pages, so no requantization happens on
+    # either side of the wire); the bf16 pytree has no scale leaves and
+    # the operands are simply unused
+    scale_fields = {}
+    if state.scales_k:  # lint: allow[jit-traced-branch] branches on pytree STRUCTURE (empty list under bf16), not a traced value — both trace shapes are legal and cached separately
+        scale_fields = dict(
+            scales_k=[
+                put_s(s, scales_k[i])
+                for i, s in enumerate(state.scales_k)
+            ],
+            scales_v=[
+                put_s(s, scales_v[i])
+                for i, s in enumerate(state.scales_v)
+            ],
+        )
     return dataclasses.replace(
         state,
         caches_k=[
@@ -346,6 +500,7 @@ def _import_blocks(
         caches_v=[
             put(b, pages_v[i]) for i, b in enumerate(state.caches_v)
         ],
+        **scale_fields,
     )
 
 
@@ -493,6 +648,10 @@ class _ImportTask:
     tokens: list[int]
     pages_k: np.ndarray
     pages_v: np.ndarray
+    # int8 wire (kubeinfer-kvwire/2): per-block-per-head dequant scales
+    # [L, n, n_kv] f32; None on the bf16 wire
+    scales_k: np.ndarray | None = None
+    scales_v: np.ndarray | None = None
     done: threading.Event = field(default_factory=threading.Event)
     imported: int = 0
     reason: str | None = None
@@ -525,7 +684,8 @@ class ContinuousEngine:
                  max_window: int = 8,
                  layout: EngineLayout | None = None,
                  spec_draft: tuple[Params, ModelConfig] | None = None,
-                 spec_k: int = 4) -> None:
+                 spec_k: int = 4,
+                 kv_dtype: str = "bf16") -> None:
         # device layout (sharding.EngineLayout): tp=1 (the default) is
         # meshless and every placement below is the identity — the
         # engine is byte-for-byte the single-device engine. Under tp>1
@@ -723,9 +883,33 @@ class ContinuousEngine:
                     lambda x: jax.device_put(x, rep), dstate
                 )
             self._dstate = dstate
+        # paged-pool precision axis (ISSUE 15): int8 pages + per-block
+        # scales double the effective pool capacity; the stepper, the
+        # attention routers, and the wire all branch statically on the
+        # pool dtype, so the bf16 engine's traces stay byte-identical
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
+        # host telemetry: logical KV blocks quantize-committed into the
+        # pool (admit full blocks + decode/verify tail commits; imports
+        # arrive pre-quantized and are not re-counted). Monotonic —
+        # the server deltas it into a Prometheus counter.
+        self.quant_blocks_total = 0
         self._state = self.layout.shard_state(init_slot_state(
             cfg, n_slots, cache_len, params["norm"].dtype,
-            num_blocks, self.block_size,
+            num_blocks, self.block_size, kv_dtype=kv_dtype,
+        ))
+        # static pool footprint for the kubeinfer_kv_pool_bytes gauge:
+        # pages + scales + tails, global across the mesh (shape
+        # metadata only — no host sync)
+        st = self._state
+        self.kv_pool_bytes = int(sum(
+            x.nbytes for x in (
+                *st.caches_k, *st.caches_v, *st.scales_k,
+                *st.scales_v, *st.tails_k, *st.tails_v,
+            )
         ))
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slot_req: list[_Request | None] = [None] * n_slots
@@ -822,6 +1006,8 @@ class ContinuousEngine:
         stats = self._radix.stats()
         stats["blocks_in_use"] = self._pool.used_blocks
         stats["blocks_free"] = self._pool.free_blocks
+        stats["pool_bytes"] = self.kv_pool_bytes
+        stats["quant_blocks"] = self.quant_blocks_total
         return stats
 
     def cache_summary(self) -> dict:
@@ -834,7 +1020,10 @@ class ContinuousEngine:
 
     def import_prefix(self, tokens: list[int], pages_k: np.ndarray,
                       pages_v: np.ndarray,
-                      timeout_s: float = 10.0) -> tuple[int, str | None]:
+                      timeout_s: float = 10.0,
+                      scales_k: np.ndarray | None = None,
+                      scales_v: np.ndarray | None = None,
+                      kv_dtype: str = "bf16") -> tuple[int, str | None]:
         """Land a remotely prefilled prefix in the local pool + radix
         cache (disaggregated prefill, disagg/). Callable from any
         thread: the scatter is staged for the scheduler thread — the
@@ -849,15 +1038,31 @@ class ContinuousEngine:
         the cache dtype — the caller (disagg.client) has already
         verified the fingerprint chain, so a shape mismatch here means
         a mis-configured fleet, not corruption."""
+        if kv_dtype != self.kv_dtype:
+            # cross-dtype pages are structurally unusable (an int8 page
+            # without its scales, or bf16 pages a quantized pool would
+            # have to requantize blind) — reject before staging so the
+            # caller counts a low-cardinality fallback and prefills
+            # locally
+            return 0, "kv_dtype_mismatch"
         if pages_k.ndim != 5 or pages_k.shape != pages_v.shape:
             return 0, "shape_mismatch"
         n = int(pages_k.shape[1])
         if n == 0 or n > self.max_blocks or \
                 len(tokens) != n * self.block_size:
             return 0, "shape_mismatch"
+        if kv_dtype == "int8":
+            want_s = (pages_k.shape[0], n, pages_k.shape[3])
+            if (
+                scales_k is None or scales_v is None
+                or tuple(scales_k.shape) != want_s
+                or tuple(scales_v.shape) != want_s
+            ):
+                return 0, "shape_mismatch"
         if self._stop.is_set() or self._thread is None:
             return 0, "stopped"
-        task = _ImportTask(list(tokens), pages_k, pages_v)
+        task = _ImportTask(list(tokens), pages_k, pages_v,
+                           scales_k=scales_k, scales_v=scales_v)
         with self._lock:
             self._imports.append(task)
         self._note("import_staged", blocks=n)
@@ -889,10 +1094,19 @@ class ContinuousEngine:
         want = (L, n, bs, n_kv, D)
         cache_dt = np.dtype(self._state.caches_k[0].dtype)
         if (
+            np.dtype(task.pages_k.dtype) != cache_dt
+            or np.dtype(task.pages_v.dtype) != cache_dt
+        ):
+            # distinct from shape_mismatch: a dtype disagreement means
+            # the fleet mixes kv_dtype configurations, which the wire's
+            # version negotiation should have caught upstream
+            task.reason = "kv_dtype_mismatch"
+            self._note("import_reject", blocks=n, reason=task.reason)
+            task.done.set()
+            return
+        if (
             tuple(task.pages_k.shape) != want
             or tuple(task.pages_v.shape) != want
-            or np.dtype(task.pages_k.dtype) != cache_dt
-            or np.dtype(task.pages_v.dtype) != cache_dt
         ):
             task.reason = "shape_mismatch"
             self._note("import_reject", blocks=n, reason=task.reason)
@@ -916,10 +1130,18 @@ class ContinuousEngine:
         pk[:, :n] = task.pages_k
         pv = np.zeros((L, self.max_blocks, bs, n_kv, D), cache_dt)
         pv[:, :n] = task.pages_v
+        # all-ones padding keeps null-block scales at their init value;
+        # the bf16 pytree carries no scale leaves and jit drops these
+        sk = np.ones((L, self.max_blocks, n_kv), np.float32)
+        sv = np.ones((L, self.max_blocks, n_kv), np.float32)
+        if task.scales_k is not None:
+            sk[:, :n] = task.scales_k
+            sv[:, :n] = task.scales_v
         # lint: allow[lock-discipline] scheduler thread is the only _state writer; see _loop
         self._state = _import_blocks(
             self._state, jnp.asarray(table_row), jnp.asarray(own_mask),
             jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(sk), jnp.asarray(sv),
         )
         with self._lock:
             created = self._radix.insert(task.tokens, fresh)
@@ -1004,6 +1226,8 @@ class ContinuousEngine:
             "padding_waste_frac": round(prof["padding_waste_frac"], 6),
             "kv_blocks_free": kv["blocks_free"],
             "kv_blocks_in_use": kv["blocks_in_use"],
+            "kv_dtype": self.kv_dtype,
+            "kv_pool_bytes": kv["pool_bytes"],
             "prefix_hit_rate": round(
                 kv["hits"] / lookups if lookups else 0.0, 6
             ),
@@ -1405,6 +1629,11 @@ class ContinuousEngine:
         # including this one's fresh blocks (their KV is committed by
         # the scatter above; the partial tail block stays private)
         full = p // self.block_size
+        if self.kv_dtype == "int8":
+            # every owned full block was quantize-committed by the
+            # scatter above (chunked prefills requantize the same
+            # blocks — one logical commit, counted once here)
+            self.quant_blocks_total += max(0, full - reuse)
         if full:
             self._radix.insert(
                 tokens, [int(b) for b in task.table_row[:full]]
@@ -1440,7 +1669,21 @@ class ContinuousEngine:
                 "pages_v": pages_v,
                 "fingerprints": [fp for _, fp in pairs],
                 "block_size": self.block_size,
+                "kv_dtype": self.kv_dtype,
             }
+            if self.kv_dtype == "int8":
+                # committed pages are int8 — the scales travel with
+                # them so the importer lands bit-identical blocks (the
+                # partial tail block is NOT in table_row[:full] and
+                # never leaves the engine in bf16)
+                req.kv_export["scales_k"] = np.stack([
+                    # lint: allow[host-sync] export capture (same boundary as pages_k above)
+                    np.asarray(sk[idx]) for sk in self._state.scales_k
+                ])
+                req.kv_export["scales_v"] = np.stack([
+                    # lint: allow[host-sync] export capture (same boundary as pages_k above)
+                    np.asarray(sv[idx]) for sv in self._state.scales_v
+                ])
         # the prefill already produced the next generated token —
         # except in prefill-only mode (max_new == 0, the disagg export
         # role), where the sampled token is discarded: the request's
@@ -2097,6 +2340,18 @@ class ContinuousEngine:
                         if req is None or n_dev == 0:
                             continue
                         self.spec_draft_tokens += self.spec_k
+                        if self.kv_dtype == "int8":
+                            # offset invariant: p + emitted - 1 (the
+                            # newest token's KV is uncommitted); the
+                            # device advanced this row n_dev positions
+                            # and quantize-committed one block per
+                            # boundary crossing
+                            old = len(req.prompt) \
+                                + len(req.out_tokens) - 1
+                            self.quant_blocks_total += (
+                                (old + n_dev) // self.block_size
+                                - old // self.block_size
+                            )
                         # device acceptance may overshoot the request
                         # budget or run past EOS (the window cannot
                         # stop mid-dispatch); the host emits the
@@ -2169,6 +2424,19 @@ class ContinuousEngine:
                 self._steps_since_preempt += k
                 accepted = 0
                 with self._lock:
+                    if self.kv_dtype == "int8":
+                        # every decoding row advanced k positions on
+                        # the device (retirement is host work below);
+                        # one tail block quantize-commits per boundary
+                        # crossing. Offset invariant: p + emitted - 1.
+                        for s, r in enumerate(self._slot_req):
+                            if r is None or s in prefilling:
+                                continue
+                            old = len(r.prompt) + len(r.out_tokens) - 1
+                            self.quant_blocks_total += (
+                                (old + k) // self.block_size
+                                - old // self.block_size
+                            )
                     for j in range(k):
                         t_j = step_t0 + (j + 1) * (step_t - step_t0) / k
                         for slot in range(self.n_slots):

@@ -930,6 +930,358 @@ def decode_attention_blocks_auto(q, k_pool, v_pool, block_tables, lengths,
     )
 
 
+# --- int8 (quantized pool) block-table decode attention --------------------
+#
+# kv_dtype="int8" splits each pool side into three tensors: int8 pages
+# [num_blocks, bs, n_kv, D], f32 scales [num_blocks, n_kv] (symmetric
+# per-block-per-head, kv_blocks.quantize_blocks), and a per-slot bf16
+# TAIL [n_slots, 2, bs, n_kv, D] holding the row's current partial
+# block plus the one a verify window can spill into (n_emit <= k+1 <
+# block_size bounds a window to ONE boundary crossing). Dequantization
+# happens HERE, next to the table gather — committed blocks never
+# round-trip to bf16 in HBM — while tiles at or past the row's tail
+# base (lengths - T) // bs read the bf16 tail verbatim, so the partial
+# block is bit-exact until the stepper commits it
+# (stepper._commit_full_tails). Scales ride the scalar-prefetch SMEM
+# path bitcast to i32 (SMEM is integer-typed; one f32 per (bh, ts) grid
+# step), the same trick as the guide's quantized-matmul example.
+
+
+def _dequant_tile(kq, scale_bits, tail, use_tail, out_dtype):
+    """One tile's effective K (or V): dequantized int8 page, or the
+    bf16 tail verbatim when ``use_tail``. Shared verbatim by the q8
+    kernel and its jnp twin — the bit-identity contract runs through
+    this function exactly as the fold runs through _fold_tile_math."""
+    scale = jax.lax.bitcast_convert_type(scale_bits, jnp.float32)
+    deq = kq.astype(jnp.float32) * scale
+    return jnp.where(use_tail, tail.astype(jnp.float32), deq).astype(
+        out_dtype
+    )
+
+
+def _decode_blocks_q8_kernel(
+    tbl_ref,  # scalar-prefetch i32[B, max_blocks]
+    len_ref,  # scalar-prefetch i32[B]
+    tb_ref,  # scalar-prefetch i32[B]: first tail-resident block per row
+    ks_ref,  # scalar-prefetch i32[B*n_kv, max_blocks]: f32 K scales, bitcast
+    vs_ref,  # scalar-prefetch i32[B*n_kv, max_blocks]
+    q_ref,  # [1, T*G, D]
+    k_ref,  # [1, 1, block_size, D] int8 pool tile
+    v_ref,  # [1, 1, block_size, D] int8
+    kt_ref,  # [1, 1, 1, block_size, D] bf16 tail tile
+    vt_ref,  # [1, 1, 1, block_size, D]
+    o_ref,  # [1, T*G, D] out
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    groups: int,
+    scale: float,
+    n_blocks: int,
+    block_size: int,
+    n_kv: int,
+    n_q: int,
+):
+    """_decode_blocks_kernel with the dequant-or-tail select spliced in
+    front of the fold; init/skip/penalty/finish are carried over
+    unchanged (the quantized pool changes tile VALUES, never the walk).
+    Dead tiles still fold exactly 0 whatever junk they dequantize to —
+    int8 * finite scale is always finite — so the clamp-elision story
+    survives quantization untouched."""
+    del tbl_ref  # consumed by the BlockSpec index_maps, not the body
+    bh = pl.program_id(0)
+    row_len = len_ref[bh // n_kv]
+    ts = pl.program_id(1)
+
+    @pl.when(ts == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when((ts == 0) | (row_len < n_q) | (ts * block_size < row_len))
+    def _fold():
+        use_tail = ts >= tb_ref[bh // n_kv]
+        k_eff = _dequant_tile(
+            k_ref[0, 0], ks_ref[bh, ts], kt_ref[0, 0, 0], use_tail,
+            o_ref.dtype,
+        )
+        v_eff = _dequant_tile(
+            v_ref[0, 0], vs_ref[bh, ts], vt_ref[0, 0, 0], use_tail,
+            o_ref.dtype,
+        )
+        s_pos = ts * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (n_q, block_size), 1
+        )
+        q_pos = row_len - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, (n_q, block_size), 0
+        )
+        pen = jnp.where(s_pos <= q_pos, 0.0, -1e30)
+        m_new, l_new, acc_new = _fold_tile_math(
+            q_ref[0], k_eff, v_eff, pen,
+            m_scr[:], l_scr[:], acc_scr[:],
+            groups=groups, scale=scale,
+        )
+        l_scr[:] = l_new
+        acc_scr[:] = acc_new
+        m_scr[:] = m_new
+
+    @pl.when(ts == n_blocks - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def decode_attention_blocks_q8(
+    q: jax.Array,  # [B, T, n_heads, D]
+    k_pool: jax.Array,  # int8[num_blocks, block_size, n_kv, D]
+    v_pool: jax.Array,  # int8
+    k_scales: jax.Array,  # f32[num_blocks, n_kv]
+    v_scales: jax.Array,  # f32[num_blocks, n_kv]
+    k_tail: jax.Array,  # [B, 2, block_size, n_kv, D] bf16 partial blocks
+    v_tail: jax.Array,
+    block_tables: jax.Array,  # i32[B, max_blocks]
+    lengths: jax.Array,  # i32[B] live entries per row (offset + T)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged decode attention over the quantized pool. Tile walk, DMA
+    clamp, and penalty are decode_attention_blocks'; the new operands
+    are the two scale rows (gathered through the table at trace time —
+    ONE f32 per folded tile — and prefetched to SMEM as i32 bits) and
+    the per-row tails, whose BlockSpec resolves tile ts to tail slot
+    clip(ts - tail_base, 0, 1). tail_base is derived from ``lengths``
+    (the window START block (lengths - T) // bs), not passed, so the
+    kernel and every caller agree on it by construction. Twin:
+    decode_attention_blocks_q8_jnp (bit-identical — parity-tested in
+    tests/test_kv_quant.py)."""
+    B, T, n_heads, D = q.shape
+    num_blocks, block_size, n_kv = k_pool.shape[:3]
+    max_blocks = block_tables.shape[1]
+    G = n_heads // n_kv
+
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B * n_kv, T * G, D
+    )
+    kp = k_pool.transpose(0, 2, 1, 3)  # int8 [num_blocks, n_kv, bs, D]
+    vp = v_pool.transpose(0, 2, 1, 3)
+    kt = k_tail.transpose(0, 1, 3, 2, 4)  # [B, 2, n_kv, bs, D]
+    vt = v_tail.transpose(0, 1, 3, 2, 4)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    tb = jnp.maximum(lens - T, 0) // block_size  # i32[B]
+    # [B, max_blocks, n_kv] gather -> one scale per (row-head, tile),
+    # bitcast because scalar-prefetch SMEM is integer-typed
+    ksb = jax.lax.bitcast_convert_type(
+        k_scales[tbl].transpose(0, 2, 1).reshape(B * n_kv, max_blocks),
+        jnp.int32,
+    )
+    vsb = jax.lax.bitcast_convert_type(
+        v_scales[tbl].transpose(0, 2, 1).reshape(B * n_kv, max_blocks),
+        jnp.int32,
+    )
+
+    def _kv_map(bh, ts, tbl_ref, lens_ref, tb_ref, ks_ref, vs_ref,
+                n_kv=n_kv, bs=block_size, nq=T):
+        # decode_attention_blocks' clamp, verbatim (the scale gather
+        # above uses the UNclamped table — dead tiles never fold, so
+        # the pair only has to agree on folded tiles, where the clamp
+        # is the identity)
+        b = bh // n_kv
+        rl = lens_ref[b]
+        live_last = jnp.maximum(rl - 1, 0) // bs
+        step = jnp.where(rl < nq, ts, jnp.minimum(ts, live_last))
+        return (tbl_ref[b, step], bh % n_kv, 0, 0)
+
+    def _tail_map(bh, ts, tbl_ref, lens_ref, tb_ref, ks_ref, vs_ref,
+                  n_kv=n_kv):
+        b = bh // n_kv
+        return (b, jnp.clip(ts - tb_ref[b], 0, 1), bh % n_kv, 0, 0)
+
+    q_spec = pl.BlockSpec(
+        (1, T * G, D),
+        lambda bh, ts, tbl_ref, lens_ref, tb_ref, ks_ref, vs_ref: (
+            bh, 0, 0
+        ),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_size, D), _kv_map, memory_space=pltpu.VMEM
+    )
+    tail_spec = pl.BlockSpec(
+        (1, 1, 1, block_size, D), _tail_map, memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_blocks_q8_kernel, groups=G,
+            scale=1.0 / float(D) ** 0.5,
+            n_blocks=max_blocks, block_size=block_size, n_kv=n_kv,
+            n_q=T,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(B * n_kv, max_blocks),
+            in_specs=[q_spec, kv_spec, kv_spec, tail_spec, tail_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((T * G, 1), jnp.float32),
+                pltpu.VMEM((T * G, 1), jnp.float32),
+                pltpu.VMEM((T * G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * n_kv, T * G, D), q.dtype),
+        interpret=interpret,
+    )(tbl, lens, tb, ksb, vsb, qf, kp, vp, kt, vt)
+    return out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, n_heads, D
+    )
+
+
+def decode_attention_blocks_q8_jnp(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    k_scales: jax.Array,
+    v_scales: jax.Array,
+    k_tail: jax.Array,
+    v_tail: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+) -> jax.Array:
+    """The q8 kernel's jnp twin: decode_attention_blocks_jnp's walk
+    with _dequant_tile spliced in front of the fold, mirroring the
+    kernel op for op (same bitcast round-trip, same clip-to-tail-slot,
+    same cast order). Gathers the UNclamped table like the bf16 twin —
+    dead tiles fold exactly 0 on both sides whatever they dequantize
+    to."""
+    B, T, n_heads, D = q.shape
+    block_size, n_kv = k_pool.shape[1], k_pool.shape[2]
+    max_blocks = block_tables.shape[1]
+    G = n_heads // n_kv
+    BH = B * n_kv
+    scale = 1.0 / float(D) ** 0.5
+
+    qf = q.reshape(B, T, n_kv, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        BH, T * G, D
+    )
+    kp = k_pool.transpose(0, 2, 1, 3)
+    vp = v_pool.transpose(0, 2, 1, 3)
+    kt = k_tail.transpose(0, 1, 3, 2, 4)  # [B, 2, n_kv, bs, D]
+    vt = v_tail.transpose(0, 1, 3, 2, 4)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    tb = jnp.maximum(lens - T, 0) // block_size
+    ksb = jax.lax.bitcast_convert_type(
+        k_scales[tbl].transpose(0, 2, 1).reshape(BH, max_blocks),
+        jnp.int32,
+    )
+    vsb = jax.lax.bitcast_convert_type(
+        v_scales[tbl].transpose(0, 2, 1).reshape(BH, max_blocks),
+        jnp.int32,
+    )
+    row_tbl = jnp.repeat(tbl, n_kv, axis=0)
+    row_head = jnp.tile(jnp.arange(n_kv, dtype=jnp.int32), B)
+    row_len = jnp.repeat(lens, n_kv)
+    row_tb = jnp.repeat(tb, n_kv)
+    row_b = jnp.repeat(jnp.arange(B, dtype=jnp.int32), n_kv)
+
+    def _row(args):
+        qr, trow, h, rl, tbase, b, ks_row, vs_row = args
+
+        def step(carry, ts):
+            m, l, acc = carry
+            use_tail = ts >= tbase
+            rel = jnp.clip(ts - tbase, 0, 1)
+            k_eff = _dequant_tile(
+                kp[trow[ts], h], ks_row[ts], kt[b, rel, h], use_tail,
+                q.dtype,
+            )
+            v_eff = _dequant_tile(
+                vp[trow[ts], h], vs_row[ts], vt[b, rel, h], use_tail,
+                q.dtype,
+            )
+            s_pos = ts * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (T, block_size), 1
+            )
+            q_pos = rl - T + jax.lax.broadcasted_iota(
+                jnp.int32, (T, block_size), 0
+            )
+            pen = jnp.where(s_pos <= q_pos, 0.0, -1e30)
+            return _fold_tile_math(
+                qr, k_eff, v_eff, pen, m, l, acc, groups=G, scale=scale
+            ), None
+
+        init = (
+            jnp.full((T * G, 1), -1e30, jnp.float32),
+            jnp.zeros((T * G, 1), jnp.float32),
+            jnp.zeros((T * G, D), jnp.float32),
+        )
+        (m, l, acc), _ = jax.lax.scan(
+            step, init, jnp.arange(max_blocks, dtype=jnp.int32)
+        )
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    out = jax.lax.map(
+        _row, (qf, row_tbl, row_head, row_len, row_tb, row_b, ksb, vsb)
+    )
+    return out.reshape(B, n_kv, T, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, n_heads, D
+    )
+
+
+def dequant_gather_block_kv(pool, scales, tail, block_tables, tail_base):
+    """gather_block_kv for the quantized pool: dequantize the gathered
+    pages (bitwise kv_blocks.dequantize_blocks' math) and overlay the
+    two tail-resident tiles verbatim, returning the [B, max_blocks*bs,
+    n_kv, D] linear view in the tail's (compute) dtype. The dense
+    fallback AND the GSPMD route: every op here partitions over n_kv
+    (pages axis 2, scales axis 1, tail axis 3), the gathers index only
+    replicated axes."""
+    nb, bs, n_kv, D = pool.shape
+    B, M = block_tables.shape
+    deq = (
+        pool[block_tables].astype(jnp.float32)
+        * scales[block_tables][:, :, None, :, None]
+    )  # [B, M, bs, n_kv, D] f32
+    rel = jnp.arange(M, dtype=jnp.int32)[None, :] - tail_base[:, None]
+    use_tail = (rel >= 0) & (rel < 2)
+    tg = tail[jnp.arange(B)[:, None], jnp.clip(rel, 0, 1)]
+    out = jnp.where(
+        use_tail[:, :, None, None, None], tg.astype(jnp.float32), deq
+    )
+    return out.astype(tail.dtype).reshape(B, M * bs, n_kv, D)
+
+
+def decode_attention_blocks_q8_auto(
+    q, k_pool, v_pool, k_scales, v_scales, k_tail, v_tail,
+    block_tables, lengths, mask, gspmd=False,
+):
+    """Quantized-pool twin of decode_attention_blocks_auto: the q8
+    Pallas kernel when shapes/backend allow, dequantize-gather + dense
+    jnp over ``mask`` otherwise (and always under ``gspmd`` — same
+    custom-call constraint). Same lengths/mask live-set contract; the
+    tail base both branches derive is (lengths - T) // block_size."""
+    T = q.shape[1]
+    block_size = k_pool.shape[1]
+    if (not gspmd) and decode_blocks_available(block_size, q.shape[3]):
+        return decode_attention_blocks_q8(
+            q, k_pool, v_pool, k_scales, v_scales, k_tail, v_tail,
+            block_tables, lengths,
+        )
+    tb = jnp.maximum(jnp.asarray(lengths, jnp.int32) - T, 0) // block_size
+    return dense_attention(
+        q,
+        dequant_gather_block_kv(
+            k_pool, k_scales, k_tail, block_tables, tb
+        ),
+        dequant_gather_block_kv(
+            v_pool, v_scales, v_tail, block_tables, tb
+        ),
+        mask,
+    )
+
+
 # --- backward (recompute-based custom_vjp over the ragged kernel) ----------
 
 

@@ -266,28 +266,65 @@ def decoder_layer(
                     "(prefill writes go through the engine's paged "
                     "admit, not decoder_layer)"
                 )
-            # paged decode write: one batched scatter into the pool.
-            # Rows of a retired slot carry an all-null table, so their
-            # write lands in the sacrificial block 0 — duplicate
-            # indices there make block 0's content nondeterministic,
-            # which is fine because nothing ever attends to it.
-            bs = ck.shape[1]
-            rows = jnp.arange(block_tables.shape[0])
-            if T == 1:
-                blk = block_tables[rows, cache_offset // bs]
-                ck = ck.at[blk, cache_offset % bs].set(k[:, 0])
-                cv = cv.at[blk, cache_offset % bs].set(v[:, 0])
+            if isinstance(ck, tuple):
+                # quantized pool: the cache entry is (int8 pages,
+                # scales, bf16 tails). Fresh K/V lands in the per-slot
+                # TAIL, never the pool — quantize-on-commit happens at
+                # the window boundary (stepper._commit_full_tails), so
+                # a partial block never round-trips through int8. Tail
+                # slot rel = pos//bs - offset//bs is 0 or 1: the window
+                # writes at most T <= k + 1 < block_size positions, so
+                # one boundary crossing max. Inactive rows scribble
+                # into their OWN tail slots — harmless, (re)admit
+                # rewrites them.
+                kq, ks, ktail = ck
+                vq, vs, vtail = cv
+                bs = kq.shape[1]
+                rows = jnp.arange(block_tables.shape[0])
+                if T == 1:
+                    # rel is identically 0: the tail was pinned to
+                    # offset // bs at window start
+                    ktail = ktail.at[rows, 0, cache_offset % bs].set(
+                        k[:, 0])
+                    vtail = vtail.at[rows, 0, cache_offset % bs].set(
+                        v[:, 0])
+                else:
+                    pos = cache_offset[:, None] + jnp.arange(T)
+                    rel = pos // bs - (cache_offset // bs)[:, None]
+                    ktail = ktail.at[
+                        rows[:, None], rel, pos % bs].set(k)
+                    vtail = vtail.at[
+                        rows[:, None], rel, pos % bs].set(v)
+                # repack and fall through: the quantized attn_fn
+                # unpacks the triple, and the epilogue below is
+                # dtype-agnostic
+                ck = (kq, ks, ktail)
+                cv = (vq, vs, vtail)
             else:
-                # speculative verify window: row b writes its T tokens
-                # at contiguous logical positions cache_offset[b] + t.
-                # Within a live row the (block, slot) pairs are
-                # distinct; cross-row collisions happen only on the
-                # null block 0 above, so scatter order never matters
-                # for anything attended to.
-                pos = cache_offset[:, None] + jnp.arange(T)
-                blk = block_tables[rows[:, None], pos // bs]
-                ck = ck.at[blk, pos % bs].set(k)
-                cv = cv.at[blk, pos % bs].set(v)
+                # paged decode write: one batched scatter into the
+                # pool. Rows of a retired slot carry an all-null
+                # table, so their write lands in the sacrificial block
+                # 0 — duplicate indices there make block 0's content
+                # nondeterministic, which is fine because nothing ever
+                # attends to it.
+                bs = ck.shape[1]
+                rows = jnp.arange(block_tables.shape[0])
+                if T == 1:
+                    blk = block_tables[rows, cache_offset // bs]
+                    ck = ck.at[blk, cache_offset % bs].set(k[:, 0])
+                    cv = cv.at[blk, cache_offset % bs].set(v[:, 0])
+                else:
+                    # speculative verify window: row b writes its T
+                    # tokens at contiguous logical positions
+                    # cache_offset[b] + t. Within a live row the
+                    # (block, slot) pairs are distinct; cross-row
+                    # collisions happen only on the null block 0
+                    # above, so scatter order never matters for
+                    # anything attended to.
+                    pos = cache_offset[:, None] + jnp.arange(T)
+                    blk = block_tables[rows[:, None], pos // bs]
+                    ck = ck.at[blk, pos % bs].set(k)
+                    cv = cv.at[blk, pos % bs].set(v)
         elif getattr(cache_offset, "ndim", 0) == 1:
             # per-row offsets (continuous-batching / ragged decode:
             # rows at different sequence positions in one dispatch)

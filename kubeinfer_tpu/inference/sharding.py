@@ -274,6 +274,19 @@ class EngineLayout:
         whole per device so logical table indices resolve everywhere."""
         return NamedSharding(self.mesh, P(None, None, "tp", None))
 
+    def scale_sharding(self) -> NamedSharding:
+        """[num_blocks, n_kv] int8 dequant scales: shard along n_kv
+        exactly like the pool — each device holds its own heads'
+        scales for EVERY block, so the kernel's scale prefetch never
+        crosses devices."""
+        return NamedSharding(self.mesh, P(None, "tp"))
+
+    def tail_sharding(self) -> NamedSharding:
+        """[n_slots, 2, block_size, n_kv, D] bf16 tail pairs: n_kv
+        shards with the pool (dim 3); slots and the 2-slot tail axis
+        stay whole per device."""
+        return NamedSharding(self.mesh, P(None, None, None, "tp", None))
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
@@ -286,16 +299,24 @@ class EngineLayout:
         if not self.sharded:
             return state
         pool = self.pool_sharding()
+        scale = self.scale_sharding()
+        tail = self.tail_sharding()
         rep = self.replicated()
+        kv_names = ("caches_k", "caches_v", "scales_k", "scales_v",
+                    "tails_k", "tails_v")
         placed = {
             f.name: jax.device_put(getattr(state, f.name), rep)
             for f in dataclasses.fields(state)
-            if f.name not in ("caches_k", "caches_v")
+            if f.name not in kv_names
         }
         return dataclasses.replace(
             state,
             caches_k=[jax.device_put(c, pool) for c in state.caches_k],
             caches_v=[jax.device_put(c, pool) for c in state.caches_v],
+            scales_k=[jax.device_put(s, scale) for s in state.scales_k],
+            scales_v=[jax.device_put(s, scale) for s in state.scales_v],
+            tails_k=[jax.device_put(t, tail) for t in state.tails_k],
+            tails_v=[jax.device_put(t, tail) for t in state.tails_v],
             **placed,
         )
 

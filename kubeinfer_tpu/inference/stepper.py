@@ -54,7 +54,9 @@ from kubeinfer_tpu.inference.engine import (
 from kubeinfer_tpu.inference.flash_attention import (
     decode_attention_auto,
     decode_attention_blocks_auto,
+    decode_attention_blocks_q8_auto,
 )
+from kubeinfer_tpu.inference.kv_blocks import quantize_blocks
 from kubeinfer_tpu.inference.model import Params, forward
 
 __all__ = [
@@ -81,7 +83,19 @@ class SlotState:
     p lives in ``caches_k[l][tables[b, p // bs], p % bs]``. Block 0 is
     the reserved null block (kv_blocks.NULL_BLOCK): dead table entries
     and retired rows point there, so every gather/scatter index is
-    always valid without data-dependent control flow under jit."""
+    always valid without data-dependent control flow under jit.
+
+    ``kv_dtype="int8"`` (trace-static: ``caches_k[0].dtype``) adds the
+    quantized-pool companions: per-(block, head) dequant scales and the
+    per-slot bf16 TAIL [B, 2, bs, n_kv, D] — slot 0 is the row's
+    current partial block (logical block offset // bs), slot 1 the one
+    a verify window may spill into. Decode scatters land in the tail
+    (model.decoder_layer), attention overlays it past the committed
+    blocks (flash_attention q8 readers), and the window boundary
+    quantizes just-filled slot-0 blocks into the pool
+    (:func:`_commit_full_tails`). In bf16 mode all four are EMPTY
+    lists — valid pytrees that keep every trace byte-identical to the
+    pre-quantization engine."""
 
     caches_k: list[jax.Array]  # L x [num_blocks, block_size, n_kv, D]
     caches_v: list[jax.Array]
@@ -95,23 +109,54 @@ class SlotState:
     rep_penalty: jax.Array  # f32[B]; 1.0 = disabled
     seen: jax.Array  # bool[B, V] ids in prompt or generated so far
     rng: jax.Array  # u32[B, 2] per-slot PRNG key data
+    scales_k: list[jax.Array]  # int8: L x f32[num_blocks, n_kv]; else []
+    scales_v: list[jax.Array]
+    tails_k: list[jax.Array]  # int8: L x [B, 2, bs, n_kv, D]; else []
+    tails_v: list[jax.Array]
 
 
 jax.tree_util.register_dataclass(
     SlotState,
     data_fields=["caches_k", "caches_v", "tables", "last_token", "offset",
                  "active", "temperature", "top_k", "top_p", "rep_penalty",
-                 "seen", "rng"],
+                 "seen", "rng", "scales_k", "scales_v", "tails_k",
+                 "tails_v"],
     meta_fields=[],
 )
 
 
 def init_slot_state(cfg: ModelConfig, n_slots: int, cache_len: int,
-                    dtype, num_blocks: int, block_size: int) -> SlotState:
+                    dtype, num_blocks: int, block_size: int,
+                    kv_dtype: str = "bf16") -> SlotState:
+    """``kv_dtype="bf16"`` stores pool pages in the compute ``dtype``
+    (the historical layout — the name is the CLI axis, not the literal
+    array dtype, so f32 test engines stay f32); ``"int8"`` stores int8
+    pages + f32 scales and allocates the per-slot bf16 tails."""
+    L = cfg.num_hidden_layers
     shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        page_dt = jnp.int8
+        sshape = (num_blocks, cfg.num_key_value_heads)
+        tshape = (n_slots, 2, block_size, cfg.num_key_value_heads,
+                  cfg.head_dim)
+        scales_k = [jnp.ones(sshape, jnp.float32) for _ in range(L)]
+        scales_v = [jnp.ones(sshape, jnp.float32) for _ in range(L)]
+        tails_k = [jnp.zeros(tshape, dtype) for _ in range(L)]
+        tails_v = [jnp.zeros(tshape, dtype) for _ in range(L)]
+    elif kv_dtype == "bf16":
+        page_dt = dtype
+        scales_k, scales_v, tails_k, tails_v = [], [], [], []
+    else:
+        raise ValueError(
+            f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}"
+        )
     return SlotState(
-        caches_k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
-        caches_v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_hidden_layers)],
+        caches_k=[jnp.zeros(shape, page_dt) for _ in range(L)],
+        caches_v=[jnp.zeros(shape, page_dt) for _ in range(L)],
+        scales_k=scales_k,
+        scales_v=scales_v,
+        tails_k=tails_k,
+        tails_v=tails_v,
         tables=jnp.zeros((n_slots, cache_len // block_size), jnp.int32),
         last_token=jnp.zeros((n_slots,), jnp.int32),
         offset=jnp.zeros((n_slots,), jnp.int32),
@@ -217,9 +262,22 @@ def step_forward(
                 q, k, v, offset + 1, m, gspmd=sharded
             )
     else:
-        def attn_fn(q, k, v, m):
+        # int8 pool: cache entries are (pages, scales, tail) triples
+        # (trace-static pytree structure), routed to the dequant-in-
+        # kernel readers; decoder_layer scattered the step's K/V into
+        # the tail, never the quantized pages
+        quantized = bool(kv_caches) and isinstance(kv_caches[0][0], tuple)
+
+        def attn_fn(q, kc, vc, m):
+            if quantized:
+                kp, ks, ktl = kc
+                vp, vs, vtl = vc
+                return decode_attention_blocks_q8_auto(
+                    q, kp, vp, ks, vs, ktl, vtl, block_tables,
+                    offset + 1, m, gspmd=sharded,
+                )
             return decode_attention_blocks_auto(
-                q, k, v, block_tables, offset + 1, m, gspmd=sharded
+                q, kc, vc, block_tables, offset + 1, m, gspmd=sharded
             )
     logits, kv_caches = forward(
         params, tok[:, None], cfg,
@@ -236,6 +294,58 @@ def step_forward(
 # --- the continuous batcher's fused window ---------------------------------
 
 
+def _zip_kv(state: SlotState):
+    """Per-layer cache entries for forward(): (k, v) pairs in bf16
+    mode, ((pages, scales, tail), ...) triples in int8 mode. The
+    branch is trace-static (pool dtype), so each kv_dtype compiles its
+    own program — exactly the one-shape-per-(K, layout, kv_dtype)
+    contract."""
+    if state.caches_k and state.caches_k[0].dtype == jnp.int8:
+        return [
+            ((pk, sk, tk), (pv, sv, tv))
+            for pk, sk, tk, pv, sv, tv in zip(
+                state.caches_k, state.scales_k, state.tails_k,
+                state.caches_v, state.scales_v, state.tails_v,
+            )
+        ]
+    return list(zip(state.caches_k, state.caches_v))
+
+
+def _commit_full_tails(pools, scales, tails, tables, old_off, new_off,
+                       keep, block_size):
+    """Quantize-on-commit: rows whose window moved ``offset`` across a
+    block boundary have just FILLED tail slot 0 — quantize it
+    (kv_blocks.quantize_blocks) into the row's pool block + scale row
+    and shift the tail down a block (slot 0 <- slot 1, slot 1 <-
+    zeros). At most one boundary per window by construction: n_emit <=
+    k+1 <= WINDOW_BUCKETS[-1]+1 < block_size. Non-crossed rows scatter
+    their block's CURRENT value back at its own index, so duplicate
+    indices (inactive rows all naming null block 0) write identical
+    values — deterministic, the same discipline as the null-block
+    decode scatter. Returns (pools, scales, tails) lists."""
+    B = old_off.shape[0]
+    M = tables.shape[1]
+    rows = jnp.arange(B)
+    crossed = keep & (new_off // block_size > old_off // block_size)
+    blk = tables[rows, jnp.clip(old_off // block_size, 0, M - 1)]
+    out_p, out_s, out_t = [], [], []
+    for pool, sc, tail in zip(pools, scales, tails):
+        qv, sv = quantize_blocks(tail[:, 0])
+        out_p.append(pool.at[blk].set(
+            jnp.where(crossed[:, None, None, None], qv, pool[blk])
+        ))
+        out_s.append(sc.at[blk].set(
+            jnp.where(crossed[:, None], sv, sc[blk])
+        ))
+        shifted = jnp.concatenate(
+            [tail[:, 1:], jnp.zeros_like(tail[:, :1])], axis=1
+        )
+        out_t.append(jnp.where(
+            crossed[:, None, None, None, None], shifted, tail
+        ))
+    return out_p, out_s, out_t
+
+
 def decode_body(
     params: Params, state: SlotState, cfg: ModelConfig,
     sharded: bool = False,
@@ -249,13 +359,12 @@ def decode_body(
     steps trace into one program."""
     block_size = state.caches_k[0].shape[1]
     S = state.tables.shape[1] * block_size  # logical per-row cache width
+    quantized = state.caches_k[0].dtype == jnp.int8
     logits, caches = step_forward(
         params, cfg, state.last_token, state.offset,
-        list(zip(state.caches_k, state.caches_v)), S,
+        _zip_kv(state), S,
         block_tables=state.tables, sharded=sharded,
     )
-    new_k = [c[0] for c in caches]
-    new_v = [c[1] for c in caches]
     # counter offset+1: admit folds prompt_len (== first decode offset),
     # so folding the bare offset here would reuse the admit-time gumbel
     # draw and systematically double the first sampled token
@@ -265,21 +374,42 @@ def decode_body(
     )
 
     keep = state.active
+    new_off = jnp.where(keep, state.offset + 1, state.offset)
+    if quantized:
+        # the step's K/V landed in the tails; pages/scales passed
+        # through forward untouched, and the boundary commit below
+        # quantizes any tail block this token just filled
+        tails_k = [c[0][2] for c in caches]
+        tails_v = [c[1][2] for c in caches]
+        pk, sk, tk = _commit_full_tails(
+            state.caches_k, state.scales_k, tails_k, state.tables,
+            state.offset, new_off, keep, block_size,
+        )
+        pv, sv, tv = _commit_full_tails(
+            state.caches_v, state.scales_v, tails_v, state.tables,
+            state.offset, new_off, keep, block_size,
+        )
+        kv_fields = dict(caches_k=pk, caches_v=pv, scales_k=sk,
+                         scales_v=sv, tails_k=tk, tails_v=tv)
+    else:
+        kv_fields = dict(
+            # no keep-masking on the pool: a retired slot's table row
+            # is all-null (see batching._maybe_retire), so an inactive
+            # row's scatter lands in the sacrificial block 0 and the
+            # pool is taken as-is (a per-row where over a SHARED pool
+            # would be wrong anyway — rows no longer own disjoint
+            # stripes)
+            caches_k=[c[0] for c in caches],
+            caches_v=[c[1] for c in caches],
+        )
     # dataclasses.replace carries unchanged fields automatically — a
     # full-constructor copy here silently reset any SlotState field
     # added later (this diff had to hand-thread top_k/top_p through two
     # such copies before the conversion)
     new_state = dataclasses.replace(
         state,
-        # no keep-masking on the pool: a retired slot's table row is
-        # all-null (see batching._maybe_retire), so an inactive row's
-        # scatter lands in the sacrificial block 0 and the pool is
-        # taken as-is (a per-row where over a SHARED pool would be
-        # wrong anyway — rows no longer own disjoint stripes)
-        caches_k=new_k,
-        caches_v=new_v,
         last_token=jnp.where(keep, nxt, state.last_token),
-        offset=jnp.where(keep, state.offset + 1, state.offset),
+        offset=new_off,
         # record_seen self-gates on any-penalty-enabled; masking by
         # keep afterwards preserves inactive slots
         seen=jnp.where(
@@ -287,6 +417,7 @@ def decode_body(
             record_seen(state.seen, nxt, state.rep_penalty),
             state.seen,
         ),
+        **kv_fields,
     )
     return new_state, jnp.where(keep, nxt, -1)
 
@@ -507,16 +638,31 @@ def verify_window(
     positions = o[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
     lengths = o + T
+    quantized = state.caches_k[0].dtype == jnp.int8
 
-    def attn_fn(q, kp, vp, m):
-        return decode_attention_blocks_auto(
-            q, kp, vp, state.tables, lengths, m, gspmd=sharded
-        )
+    if quantized:
+        # q8 router derives tail_base = (lengths - T) // block_size
+        # == o // block_size, the block the tail slots were pinned to
+        # at window start — exactly where decoder_layer lands the
+        # window's scatter (rel in {0, 1}, one crossing max per window
+        # since T <= k + 1 < block_size)
+        def attn_fn(q, kc, vc, m):
+            kp, ks, ktl = kc
+            vp, vs, vtl = vc
+            return decode_attention_blocks_q8_auto(
+                q, kp, vp, ks, vs, ktl, vtl, state.tables, lengths, m,
+                gspmd=sharded,
+            )
+    else:
+        def attn_fn(q, kp, vp, m):
+            return decode_attention_blocks_auto(
+                q, kp, vp, state.tables, lengths, m, gspmd=sharded
+            )
 
     logits, caches = forward(
         params, window, cfg,
         positions=positions, attn_mask=mask,
-        kv_caches=list(zip(state.caches_k, state.caches_v)),
+        kv_caches=_zip_kv(state),
         cache_offset=o, block_tables=state.tables, attn_fn=attn_fn,
     )
 
@@ -567,13 +713,31 @@ def verify_window(
         state.last_token,
     )
     keep = state.active
+    new_off = jnp.where(keep, o + n_emit, o)
+    if quantized:
+        tails_k = [c[0][2] for c in caches]
+        tails_v = [c[1][2] for c in caches]
+        pk, sk, tk = _commit_full_tails(
+            state.caches_k, state.scales_k, tails_k, state.tables,
+            o, new_off, keep, block_size,
+        )
+        pv, sv, tv = _commit_full_tails(
+            state.caches_v, state.scales_v, tails_v, state.tables,
+            o, new_off, keep, block_size,
+        )
+        kv_fields = dict(caches_k=pk, caches_v=pv, scales_k=sk,
+                         scales_v=sv, tails_k=tk, tails_v=tv)
+    else:
+        kv_fields = dict(
+            caches_k=[c[0] for c in caches],
+            caches_v=[c[1] for c in caches],
+        )
     new_state = dataclasses.replace(
         state,
-        caches_k=[c[0] for c in caches],
-        caches_v=[c[1] for c in caches],
         last_token=jnp.where(keep, last_new, state.last_token),
-        offset=jnp.where(keep, o + n_emit, o),
+        offset=new_off,
         seen=seen_f,  # already alive-masked in-scan; alive_0 = active
+        **kv_fields,
     )
     new_dstate = dataclasses.replace(
         dstate,

@@ -119,6 +119,77 @@ class TestWire:
         with pytest.raises(WireError, match="layers"):
             encode_payload(k[0], v[0], [1, 2, 3], block_size=4)
 
+    def test_round_trip_v2_int8(self):
+        k, v = _pages(dtype=np.int8)
+        rng = np.random.default_rng(3)
+        sk = rng.random((2, 3, 2)).astype(np.float32)
+        sv = rng.random((2, 3, 2)).astype(np.float32)
+        blob = encode_payload(k, v, [1, 2, 3], block_size=4,
+                              scales_k=sk, scales_v=sv, kv_dtype="int8")
+        assert blob.split(b"\n", 1)[0].startswith(
+            b'{"magic": "kubeinfer-kvwire/2"'
+        )
+        p = decode_payload(blob)
+        assert p.kv_dtype == "int8"
+        assert np.array_equal(p.pages_k, k)
+        assert np.array_equal(p.scales_k, sk)
+        assert np.array_equal(p.scales_v, sv)
+        assert p.byte_size == k.nbytes + v.nbytes + sk.nbytes + sv.nbytes
+
+    def test_bf16_export_stays_v1_byte_identical(self):
+        # a pre-quantization fleet must see the exact v1 bytes it
+        # always did — the v2 magic appears only when scales do
+        k, v = _pages()
+        blob = encode_payload(k, v, [1, 2, 3], block_size=4)
+        assert blob.split(b"\n", 1)[0].startswith(
+            b'{"magic": "kubeinfer-kvwire/1"'
+        )
+        assert b"kv_dtype" not in blob.split(b"\n", 1)[0]
+        p = decode_payload(blob)
+        assert p.kv_dtype == "bf16" and p.scales_k is None
+
+    def test_v2_scale_corruption_fails_checksum(self):
+        k, v = _pages(dtype=np.int8)
+        sk = np.ones((2, 3, 2), np.float32)
+        blob = bytearray(encode_payload(
+            k, v, [1, 2, 3], block_size=4,
+            scales_k=sk, scales_v=sk, kv_dtype="int8",
+        ))
+        blob[-3] ^= 0x10  # deep in the V scales
+        with pytest.raises(WireError, match="checksum"):
+            decode_payload(bytes(blob))
+
+    def test_encode_validates_dtype_scale_agreement(self):
+        k, v = _pages(dtype=np.int8)
+        sk = np.ones((2, 3, 2), np.float32)
+        with pytest.raises(WireError, match="together"):
+            encode_payload(k, v, [1, 2, 3], block_size=4, scales_k=sk,
+                           kv_dtype="int8")
+        with pytest.raises(WireError, match="inconsistent"):
+            encode_payload(k, v, [1, 2, 3], block_size=4,
+                           kv_dtype="int8")
+        with pytest.raises(WireError, match="inconsistent"):
+            encode_payload(k, v, [1, 2, 3], block_size=4,
+                           scales_k=sk, scales_v=sk)
+        with pytest.raises(WireError, match="float32"):
+            encode_payload(k, v, [1, 2, 3], block_size=4,
+                           scales_k=sk.astype(np.float64),
+                           scales_v=sk, kv_dtype="int8")
+
+    def test_v2_header_claiming_bf16_rejected(self):
+        # a forged v2 header downgrading kv_dtype would make the body
+        # length check pass against phantom scale bytes — refuse it at
+        # the header parse
+        k, v = _pages(dtype=np.int8)
+        sk = np.ones((2, 3, 2), np.float32)
+        blob = encode_payload(k, v, [1, 2, 3], block_size=4,
+                              scales_k=sk, scales_v=sk, kv_dtype="int8")
+        nl = blob.find(b"\n")
+        hdr = json.loads(blob[:nl])
+        hdr["kv_dtype"] = "bf16"
+        with pytest.raises(WireError, match="bf16"):
+            decode_payload(json.dumps(hdr).encode() + blob[nl:])
+
     def test_header_shape_inconsistency_detected(self):
         # a header claiming a different block count than its body
         # implies must fail on the implied-size check, not reshape junk
@@ -268,6 +339,69 @@ class TestEngineImport:
         finally:
             eng.stop()
 
+    def test_int8_export_import_parity(self, params):
+        """The disaggregation contract under quantization: decode over
+        imported int8 pages + scales is token-identical to the int8
+        engine's own cold prefill (NOT to bf16 — the int8 path is
+        tolerance-pinned against bf16, but exact against itself)."""
+        p = prompt_tokens(70)
+        ref = mk_engine(params, kv_dtype="int8")
+        ref_g = ref.generate(p, max_new_tokens=6, eos_id=-1)
+        ref.stop()
+
+        a = mk_engine(params, kv_dtype="int8")
+        exp = a.serve(p, max_new_tokens=0, eos_id=-1,
+                      export_kv=True).kv_export
+        a.stop()
+        assert exp["kv_dtype"] == "int8"
+        assert exp["pages_k"].dtype == np.int8
+        payload = decode_payload(encode_payload(
+            exp["pages_k"], exp["pages_v"], exp["fingerprints"],
+            exp["block_size"], scales_k=exp["scales_k"],
+            scales_v=exp["scales_v"], kv_dtype="int8",
+        ))
+
+        b = mk_engine(params, kv_dtype="int8")
+        try:
+            fps = prefix_fingerprints(p, BS)
+            n, reason = b.import_prefix(
+                p[:len(fps) * BS], payload.pages_k, payload.pages_v,
+                scales_k=payload.scales_k, scales_v=payload.scales_v,
+                kv_dtype="int8",
+            )
+            assert (n, reason) == (len(fps), None)
+            assert b.generate(p, max_new_tokens=6, eos_id=-1) == ref_g
+        finally:
+            b.stop()
+
+    def test_import_rejects_kv_dtype_mismatch(self, params):
+        # both directions: a bf16 blob must not scatter into an int8
+        # pool (its pages would be reinterpreted as quantized) and an
+        # int8 blob must not scatter into a bf16 pool
+        p = list(range(BS))
+        int8_eng = mk_engine(params, kv_dtype="int8")
+        try:
+            exp_shape = (TINY.num_hidden_layers, 1, BS,
+                         TINY.num_key_value_heads, TINY.head_dim)
+            kk = np.zeros(exp_shape, np.float32)
+            n, reason = int8_eng.import_prefix(p, kk, kk)
+            assert (n, reason) == (0, "kv_dtype_mismatch")
+        finally:
+            int8_eng.stop()
+        bf16_eng = mk_engine(params)
+        try:
+            exp_shape = (TINY.num_hidden_layers, 1, BS,
+                         TINY.num_key_value_heads, TINY.head_dim)
+            kq = np.zeros(exp_shape, np.int8)
+            sc = np.ones((TINY.num_hidden_layers, 1,
+                          TINY.num_key_value_heads), np.float32)
+            n, reason = bf16_eng.import_prefix(
+                p, kq, kq, scales_k=sc, scales_v=sc, kv_dtype="int8",
+            )
+            assert (n, reason) == (0, "kv_dtype_mismatch")
+        finally:
+            bf16_eng.stop()
+
 
 class TestClient:
     def test_fetch_unreachable_is_fetch_error(self, params):
@@ -290,6 +424,41 @@ class TestClient:
                 eng, prompt_tokens(BS - 1), "http://127.0.0.1:9",
             )
             assert (n, reason, nbytes) == (0, "no_full_block", 0)
+        finally:
+            eng.stop()
+
+    def test_wire_v1_blob_rejected_by_int8_importer(self, params,
+                                                    monkeypatch):
+        """Mixed-fleet regression: a pre-quantization (wire v1, bf16)
+        prefill replica answering an int8 decode replica must degrade
+        to local prefill with the kv_dtype_mismatch fallback reason —
+        never scatter bf16 bytes into the quantized pool, and never
+        misreport the valid v1 blob as a wire error."""
+        p = prompt_tokens(70)
+        a = mk_engine(params)  # bf16 exporter -> v1 on the wire
+        exp = a.serve(p, max_new_tokens=0, eos_id=-1,
+                      export_kv=True).kv_export
+        a.stop()
+        blob = encode_payload(exp["pages_k"], exp["pages_v"],
+                              exp["fingerprints"], exp["block_size"])
+        assert blob.split(b"\n", 1)[0].startswith(
+            b'{"magic": "kubeinfer-kvwire/1"'
+        )
+
+        import kubeinfer_tpu.disagg.client as client_mod
+
+        monkeypatch.setattr(
+            client_mod, "fetch_kv_blocks",
+            lambda *a, **kw: decode_payload(blob),
+        )
+        eng = mk_engine(params, kv_dtype="int8")
+        try:
+            n, reason, nbytes = import_remote_prefix(
+                eng, p, "http://unused",
+            )
+            assert (n, reason) == (0, "kv_dtype_mismatch")
+            assert nbytes > 0  # the blob was fetched and decoded fine
+            assert eng.imports_total == 0  # never reached the engine
         finally:
             eng.stop()
 

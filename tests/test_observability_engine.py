@@ -355,8 +355,13 @@ class TestEngineInstrumentation:
             "kv_blocks_free", "kv_blocks_in_use", "prefix_hit_rate",
             "prefix_cached_tokens", "cache_summary",
             "tp_degree", "mesh_devices",
+            "kv_dtype", "kv_pool_bytes",
         }
         assert s["n_slots"] == 2
+        # default engine runs the bf16 pool; pool bytes are static per
+        # config and must be nonzero (the /metrics gauge leans on this)
+        assert s["kv_dtype"] == "bf16"
+        assert s["kv_pool_bytes"] > 0
         # unsharded engine: the layout gauges report the degenerate
         # single-device layout, not an absent one
         assert s["tp_degree"] == 1
