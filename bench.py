@@ -1829,6 +1829,241 @@ def disagg_serving_bench(n_long=4, n_short=12, long_new=4, short_new=32,
     }
 
 
+def migration_bench(n_sessions=3, prompt_len=96, n_new=64,
+                    model="bench-280m", seed=23, min_tokens=2):
+    """Live-session migration phase (drain/evacuate/rebalance PR): what
+    does handing a decoding session to another replica cost, and what
+    does the streamed KV chain buy over throwing the cache away?
+
+    One source + two targets, all with ``migration_chunk_blocks=1`` so
+    every streamed chunk is exactly one block keyed by its own
+    fingerprint — chunk boundaries then never depend on how far decode
+    ran before the drain landed, which keeps the timed fetch loop and
+    the target's chunked importer aligned with the source's exports.
+    Per session (fresh seeded prompt, so no cross-session trie warmth):
+    submit on the source, wait for a few live tokens, ``POST
+    /admin/drain`` mid-decode, and collect the parked partial
+    (finish_reason=migrated). Then:
+
+    - ``migration_mbytes_per_sec``: timed refetch of the session's
+      exported chunk chain from ``/kv/blocks`` (wire bytes / wall
+      time) — per-block fetches, i.e. the chunked stream's real
+      request cadence, not one amortized blob;
+    - ``ttft_ms_p99_rebalance``: resume on a target WITH ``kv_source``
+      — the warm path imports the chain and admits only the suffix
+      bucket;
+    - ``ttft_ms_p99_reprefill``: the same resume on a second (cold)
+      target WITHOUT ``kv_source`` — the fallback path re-prefills
+      prompt + partial from scratch. The delta is what migration buys.
+
+    Both TTFTs come from the replica's own ``kubeinfer.ttft_ms`` stamp
+    (queue-wait + prefill — the serving breakdown's definition), same
+    prompt, same parked tokens, so the comparison is purely
+    import-vs-recompute. ``migration_token_parity`` gates the whole
+    path: the parked partial must be a prefix of the cold
+    single-engine reference and BOTH resumes must complete it
+    token-identically (one session runs sampled — temperature/top_p/
+    seed — so the position-folded resample rule is exercised, not just
+    greedy argmax). Sessions that happen to finish before the drain
+    lands are excluded from the timing samples (their resume is the
+    degenerate answer-directly path, which would fake a ~0 TTFT), and
+    so is the sampled session — it gates parity only, because its
+    temperature trace compiles fresh on both targets and the compile
+    would swamp a 3-sample p99 (the comment at the sample site).
+
+    The prompt/budget shape is a RACE constraint, not a workload
+    choice: the drain streams ONE chunk per scheduler pass while
+    decode keeps running (by design — the stream chases the head
+    instead of stalling it), so a session only hands off if its
+    remaining decode windows outnumber its committed blocks. A long
+    prompt with a short budget always finishes before the stream
+    catches up and nothing migrates; 3 prompt blocks against ~7
+    remaining windows gives the stream a comfortable margin while
+    re-prefill still costs a real 280m prefill dispatch.
+
+    ``bench-280m`` for the same reason as the disagg phase: re-prefill
+    must cost real matmul time or the warm path has nothing to beat.
+    CPU-pinned like every serving phase. The first session is a shape
+    warmup (admit buckets, import/export and resume shapes — the jit
+    cache is process-global) and drops out of every sample.
+    """
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.inference.engine import Engine
+    from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+    from kubeinfer_tpu.inference.server import InferenceServer
+
+    cfg = PRESETS[model]
+    rng = np.random.default_rng(seed)
+    block_size, cache_len, n_slots = 32, 1024, 2
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_sessions + 1)  # +1 warmup
+    ]
+    # one measured session runs sampled so resume parity covers the
+    # position-folded resample rule, not just greedy argmax
+    sampled_idx = 2 if n_sessions >= 2 else 1
+    sampling = {"temperature": 0.8, "top_p": 0.9, "seed": 7}
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+        ref_eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            block_size=block_size,
+        ).start()
+        try:
+            expect = [
+                ref_eng.generate(
+                    p, max_new_tokens=n_new,
+                    **(sampling if i == sampled_idx else {}),
+                )
+                for i, p in enumerate(prompts)
+            ]
+        finally:
+            ref_eng.stop()
+        _touch_progress()
+
+        servers = {}
+        for name in ("src", "warm", "cold"):
+            cont = ContinuousEngine(
+                params, cfg, n_slots=n_slots, cache_len=cache_len,
+                block_size=block_size, migration_chunk_blocks=1,
+            ).start()
+            srv = InferenceServer(
+                Engine(params, cfg), model_id=name, port=0,
+                continuous=cont,
+            ).start()
+            servers[name] = (srv, cont)
+        src_srv, src_cont = servers["src"]
+        src_url = f"http://127.0.0.1:{src_srv.port}"
+        try:
+            rebal, repre, parity = [], [], True
+            xfer_bytes = xfer_s = 0.0
+            migrated_sessions = 0
+            for i, p in enumerate(prompts):
+                extra = sampling if i == sampled_idx else {}
+                box = {}
+
+                def client(p=p, extra=extra, box=box):
+                    box["doc"] = post(src_srv.port, {
+                        "prompt": p, "max_tokens": n_new, **extra,
+                    })
+
+                t = threading.Thread(target=client)
+                t.start()
+                deadline = time.monotonic() + 300.0
+                while time.monotonic() < deadline and t.is_alive():
+                    if any(
+                        r is not None and len(r.out_tokens) >= min_tokens
+                        for r in src_cont._slot_req
+                    ):
+                        break
+                    time.sleep(0.002)
+                drain_req = urllib.request.Request(
+                    f"{src_url}/admin/drain", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(drain_req, timeout=300) as r:
+                    report = json.loads(r.read())
+                if not report.get("drained"):
+                    raise RuntimeError(f"source failed to drain: {report}")
+                t.join(300)
+                src_cont.undrain()
+                doc = box["doc"]
+                toks = doc["choices"][0]["tokens"]
+                parity &= toks == expect[i][:len(toks)]
+                migrated = (
+                    doc["choices"][0]["finish_reason"] == "migrated"
+                )
+                mig = (doc.get("kubeinfer") or {}).get("migrated") or {}
+                blocks = int(mig.get("blocks") or 0)
+                if migrated and blocks > 0 and i > 0:
+                    # the chunk chain the target would pull, refetched
+                    # here under the clock: chunk j is block j, keyed
+                    # by its own fingerprint (migration_chunk_blocks=1)
+                    fps = prefix_fingerprints(
+                        (p + toks)[:-1], block_size
+                    )[:blocks]
+                    t0 = time.perf_counter()
+                    for fp in fps:
+                        with urllib.request.urlopen(
+                            f"{src_url}/kv/blocks?fp={int(fp)}",
+                            timeout=300,
+                        ) as r:
+                            xfer_bytes += len(r.read())
+                    xfer_s += time.perf_counter() - t0
+                _touch_progress()
+                resume = {"tokens": toks}
+                warm_doc = post(servers["warm"][0].port, {
+                    "prompt": p, "max_tokens": n_new, **extra,
+                    "kubeinfer_resume": (
+                        {**resume, "kv_source": src_url}
+                        if blocks > 0 else resume
+                    ),
+                })
+                cold_doc = post(servers["cold"][0].port, {
+                    "prompt": p, "max_tokens": n_new, **extra,
+                    "kubeinfer_resume": resume,
+                })
+                parity &= warm_doc["choices"][0]["tokens"] == expect[i]
+                parity &= cold_doc["choices"][0]["tokens"] == expect[i]
+                if migrated and i > 0:
+                    migrated_sessions += 1
+                    # the sampled session is parity-only: its
+                    # temperature trace compiles fresh on BOTH targets
+                    # (the warmup session warms the greedy shapes), and
+                    # a 20s+ compile in a 3-sample p99 would swamp the
+                    # import-vs-prefill signal the phase exists for
+                    if i != sampled_idx:
+                        rebal.append(warm_doc["kubeinfer"]["ttft_ms"])
+                        repre.append(cold_doc["kubeinfer"]["ttft_ms"])
+                _touch_progress()
+            if len(rebal) < 2:
+                raise RuntimeError(
+                    f"only {len(rebal)} greedy sessions migrated "
+                    "mid-decode; timing samples are meaningless"
+                )
+        finally:
+            for srv, cont in servers.values():
+                srv.stop()
+                cont.stop()
+    finally:
+        jax.config.update("jax_default_device", prev_dev)
+    return {
+        "migration_mbytes_per_sec": round(
+            xfer_bytes / 1e6 / max(xfer_s, 1e-9), 3
+        ),
+        "ttft_ms_p99_rebalance": round(
+            float(np.percentile(np.asarray(rebal), 99)), 3
+        ),
+        "ttft_ms_p99_reprefill": round(
+            float(np.percentile(np.asarray(repre), 99)), 3
+        ),
+        "migration_token_parity": parity,
+        "migration_sessions": migrated_sessions,
+    }
+
+
 _last_progress = [0.0]
 
 
@@ -2330,6 +2565,21 @@ def main() -> None:
                 extras[key] = dg[key]
         except Exception as e:
             extras["disagg_serving_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # live-session migration phase (drain/evacuate/rebalance PR):
+        # chunked transfer-plane MB/s off /kv/blocks, resume TTFT with
+        # the streamed chain vs the re-prefill fallback, and the
+        # greedy+sampled token-parity gate over park→stream→resume
+        try:
+            mg = migration_bench()
+            for key in (
+                "migration_mbytes_per_sec",
+                "ttft_ms_p99_rebalance", "ttft_ms_p99_reprefill",
+                "migration_token_parity", "migration_sessions",
+            ):
+                extras[key] = mg[key]
+        except Exception as e:
+            extras["migration_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
 
     print(

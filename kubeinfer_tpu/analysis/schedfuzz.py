@@ -1221,6 +1221,217 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
     return verify
 
 
+def _scn_engine_migrate(fz: SchedFuzzer):
+    """Live-session drain (batching._step_drain/_migrate_slot) racing
+    admission, the retire path, a flaky migration sink, and the stop
+    sweep — over the REAL RadixCache + BlockPool.
+
+    The drain protocol under test is one-action-per-pass: sweep the
+    never-admitted queue first (those migrate with zero streamed
+    blocks), then for ONE slot per pass either stream one committed
+    chunk — pages captured under the lock, the sink called OFF it —
+    or, once the cursor caught up, finalize: insert the committed
+    blocks into the trie (the warm local fallback the router bounces
+    back to), release everything, hand the request over. A sink
+    failure must fall FORWARD to finalization with whatever already
+    streamed — the target re-prefills the rest — never retry-wedge
+    the drain. Under every schedule: only committed pages reach the
+    sink (the live tail moves with the request, not the wire), each
+    request reaches exactly one terminal state (served xor migrated
+    xor failed), and refs drain to zero. A schedule that streams a
+    tail block ships junk under a valid fingerprint; one that
+    finalizes a stop-swept slot double-frees its pool refs.
+    """
+    from kubeinfer_tpu.analysis.racecheck import make_lock
+    from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+
+    BS = 4
+    pool = BlockPool(32, BS)
+    radix = RadixCache(pool)
+    lock = make_lock("schedfuzz.engine-migrate._lock")
+    pending: list[int] = []
+    slots: dict[int, dict] = {}
+    served: list[int] = []
+    migrated: list[int] = []
+    failed: list[int] = []
+    chunks: list[tuple[int, tuple]] = []
+    state = {"stopped": False, "draining": False, "seq": 0}
+
+    def toks(rid: int) -> list[int]:
+        # two prefix families: drain finalizations and fresh admits
+        # collide on shared trie paths, so a migrated session's warm
+        # fallback is immediately re-matched by the next admit
+        return [100 * (rid % 2) + t for t in range(3 * BS)]
+
+    contents: dict[int, tuple] = {}
+    qstate: dict[int, str] = {}
+
+    def alloc_tagged(n: int, tag) -> list[int] | None:
+        if not radix.ensure_free(n):
+            return None
+        blocks = pool.alloc(n)
+        contents.update((b, (tag, i)) for i, b in enumerate(blocks))
+        qstate.update((b, "q") for b in blocks)
+        return blocks
+
+    def submitter() -> None:
+        for rid in range(6):
+            with lock:
+                if state["stopped"] or state["draining"]:
+                    # EngineDrainingError at the door: the router
+                    # re-routes; terminal HERE for the oracle
+                    failed.append(rid)
+                else:
+                    pending.append(rid)
+
+    def scheduler() -> None:
+        for _ in range(16):
+            with lock:
+                if state["stopped"]:
+                    return
+                draining = state["draining"]
+            if draining:
+                # -- _step_drain, one action per pass --------------
+                with lock:
+                    if state["stopped"]:
+                        return
+                    swept = pending[:]
+                    pending.clear()
+                if swept:
+                    with lock:
+                        migrated.extend(swept)  # streamed=0 hand-off
+                    continue
+                stream = final = None
+                with lock:
+                    if state["stopped"]:
+                        return
+                    for rid, row in slots.items():
+                        if row["cursor"] < row["committed"]:
+                            b = row["blocks"][row["cursor"]]
+                            stream = (rid, row, b, contents[b])
+                        else:
+                            final = (rid, row)
+                        break  # ONE candidate per pass
+                if stream is not None:
+                    rid, row, b, tag = stream
+                    # the sink runs OFF the lock; only committed pages
+                    # may ride the wire — the tail rides the request
+                    assert qstate[b] == "q", qstate[b]
+                    state["seq"] += 1
+                    if (state["seq"] * 2654435761) % 3 == 0:
+                        # flaky sink: fall forward — stop streaming,
+                        # finalize next pass with what already went
+                        with lock:
+                            row["cursor"] = row["committed"]
+                        continue
+                    chunks.append((rid, tag))
+                    with lock:
+                        row["cursor"] += 1
+                elif final is not None:
+                    rid, row = final
+                    with lock:
+                        if state["stopped"]:
+                            return
+                        # the slot may have been stop-swept between
+                        # the candidate scan and here — identity check
+                        # like _migrate_slot's _slot_req re-check
+                        if slots.get(rid) is not row:
+                            continue
+                        del slots[rid]
+                        n = row["committed"]
+                        radix.insert(toks(rid)[: n * BS],
+                                     row["blocks"][:n])
+                    pool.unref(row["blocks"])
+                    with lock:
+                        migrated.append(rid)
+                continue
+            # -- normal service: admit, then retire ----------------
+            with lock:
+                if state["stopped"] or state["draining"]:
+                    continue
+                if pending:
+                    rid = pending.pop(0)
+                    matched = radix.match(toks(rid))
+                    extra = alloc_tagged(3 - len(matched), ("adm", rid))
+                    if extra is None:
+                        pool.unref(matched)
+                        failed.append(rid)
+                    else:
+                        if extra:
+                            qstate[extra[-1]] = "tail"
+                        blocks = matched + extra
+                        slots[rid] = {
+                            "blocks": blocks, "cursor": 0,
+                            # a fully matched prefix is committed
+                            # content; a fresh last block is the live
+                            # bf16 tail and never committed here
+                            "committed": len(blocks) - (1 if extra else 0),
+                        }
+            drain = None
+            with lock:
+                if state["stopped"] or state["draining"]:
+                    continue
+                if slots:
+                    rid = next(iter(slots))
+                    row = slots.pop(rid)
+                    b = row["blocks"][-1]
+                    if qstate[b] == "tail":
+                        # retire commits the tail before sharing
+                        contents[b] = ("com", rid)
+                        qstate[b] = "q"
+                    radix.insert(toks(rid), row["blocks"])
+                    drain = (rid, row["blocks"])
+            if drain is not None:
+                pool.unref(drain[1])
+                with lock:
+                    served.append(drain[0])
+
+    def drainer() -> None:
+        # the seed decides where the drain lands relative to every
+        # admit/retire/stream; flipping the flag is ALL this thread
+        # does — the scheduler owns the drain work, like production
+        for _ in range(3):
+            with lock:
+                pass
+        with lock:
+            state["draining"] = True
+
+    def stopper() -> None:
+        for _ in range(4):
+            with lock:
+                pass
+        with lock:
+            state["stopped"] = True
+            leftover = pending[:]
+            pending.clear()
+            live = [(rid, row["blocks"]) for rid, row in slots.items()]
+            slots.clear()
+        for rid, blocks in live:
+            pool.unref(blocks)
+            with lock:
+                failed.append(rid)
+        with lock:
+            failed.extend(leftover)
+
+    fz.spawn("submit", submitter)
+    fz.spawn("sched", scheduler)
+    fz.spawn("drain", drainer)
+    fz.spawn("stop", stopper)
+
+    def verify() -> None:
+        assert not pending and not slots, (pending, slots)
+        assert sorted(served + migrated + failed) == list(range(6)), (
+            served, migrated, failed,
+        )
+        # every streamed chunk carried committed content
+        for _rid, tag in chunks:
+            assert tag[0] in ("adm", "com", "imp"), tag
+        assert radix.ensure_free(31), pool.used_blocks
+        assert pool.used_blocks == 0, pool.used_blocks
+        assert pool.free_blocks == 31, pool.free_blocks
+    return verify
+
+
 SCENARIOS = [
     Scenario("store-churn", _scn_store_churn),
     Scenario("breaker-storm", _scn_breaker_storm),
@@ -1235,6 +1446,7 @@ SCENARIOS = [
     Scenario("engine-spec-rollback", _scn_engine_spec_rollback),
     Scenario("engine-kv-import", _scn_engine_kv_import),
     Scenario("engine-quant-commit", _scn_engine_quant_commit),
+    Scenario("engine-migrate", _scn_engine_migrate),
 ]
 
 

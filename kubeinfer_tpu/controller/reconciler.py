@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
+from typing import Callable
 
 import numpy as np
 
@@ -64,6 +65,7 @@ class ReconcileResult:
     workloads_deleted: int = 0
     replicas_total: int = 0
     replicas_placed: int = 0
+    evacuations: int = 0
     solve_ms: dict[str, float] = field(default_factory=dict)
     duration_ms: float = 0.0
 
@@ -76,10 +78,28 @@ class Controller:
         store: Store,
         clock: Clock | None = None,
         node_ttl_s: float = NODE_HEARTBEAT_TTL_S,
+        slo_burn_limit: float = 0.0,
+        drainer: Callable[[NodeState], bool] | None = None,
     ) -> None:
         self._store = store
         self._clock = clock or RealClock()
         self._node_ttl = node_ttl_s
+        # SLO-burn evacuation (live-session migration's third caller):
+        # a node whose serving heartbeat reports slo_burn >= limit gets
+        # its sessions drained OUT before the SLO is blown, not after.
+        # The drainer is injected because the controller must not know
+        # how to reach a serving plane (in deployment it POSTs
+        # /admin/drain to the node's replica endpoint; in tests it's a
+        # recording lambda); it returns True once the drain is accepted.
+        # limit <= 0 or drainer None disables the pass.
+        self._slo_burn_limit = float(slo_burn_limit)
+        self._drainer = drainer
+        # one drain request per burn episode: the node stays hot (and
+        # possibly above the limit) for the whole drain, and hammering
+        # /admin/drain each tick would reset wait_drained clocks. The
+        # episode ends when the node's heartbeat stops reporting
+        # draining AND its burn is back under the limit.
+        self._evacuating: set[str] = set()
 
     # -- desired state (reference desiredDeployment, :182-313) ------------
 
@@ -204,6 +224,43 @@ class Controller:
             if n.ready
             and (n.heartbeat == 0.0 or now - n.heartbeat <= self._node_ttl)
         ]
+
+    def _evacuate_burning(self, nodes: list[NodeState],
+                          result: ReconcileResult) -> None:
+        """One evacuation pass over the schedulable nodes: drain the
+        serving plane of every node whose heartbeat reports an SLO
+        burn rate at or over the limit. Draining is the migration
+        entry point — the node's engine streams its live sessions'
+        KV out and the router resumes them on colder replicas — so
+        this pass converts 'about to blow the SLO' into a latency
+        blip instead of a correctness event. Failures stay candidates
+        next tick; the pass never raises into the solve."""
+        if self._slo_burn_limit <= 0 or self._drainer is None:
+            return
+        for n in nodes:
+            stats = n.serving_stats if isinstance(n.serving_stats, dict) else {}
+            name = n.metadata.name
+            burning = (
+                float(stats.get("slo_burn") or 0.0) >= self._slo_burn_limit
+            )
+            if stats.get("draining"):
+                continue  # drain in progress (ours or an operator's)
+            if not burning:
+                self._evacuating.discard(name)  # episode over
+                continue
+            if name in self._evacuating:
+                continue  # requested; heartbeat hasn't confirmed yet
+            try:
+                ok = bool(self._drainer(n))
+            except Exception:
+                log.exception("evacuation drain of %s failed", name)
+                ok = False
+            if ok:
+                self._evacuating.add(name)
+                result.evacuations += 1
+            metrics.evacuations_total.inc(
+                name, "drained" if ok else "failed"
+            )
 
     def _solve_batch(
         self,
@@ -429,6 +486,7 @@ class Controller:
 
         nodes = self._schedulable_nodes(now)
         result.nodes = len(nodes)
+        self._evacuate_burning(nodes, result)
         self._solve_batch(pairs, nodes, result)
 
         for svc, w in pairs:

@@ -12,12 +12,20 @@ store encoded wire bytes (wire.encode_payload), not arrays: the sha256
 is paid once at put time on the scheduler thread's captured pages, and
 the HTTP handler serves byte blobs without touching engine state.
 
-Capacity is entries, not bytes, because entry size is bounded by the
-engine's own cache_len — the pool could not have produced a bigger
-prefix than it holds. Eviction drops the least recently PUT-or-GOT
-entry; a dropped export only costs the importer a fallback to local
-prefill (token-identical by the determinism contract), never
-correctness.
+Capacity is entries AND (optionally) bytes. The entry cap alone was
+enough for prefill exports, whose size is bounded by the engine's own
+cache_len — but live-session migration parks CHUNKED blobs here (one
+per chunk of a long session, wire v3), so 32 entries can be anywhere
+from kilobytes to the whole pool's worth of pages. The bytes budget
+(``--kv-export-budget-mb``) bounds the real resident cost; eviction
+drops least-recently-PUT-or-GOT entries until both caps hold, but
+never the entry being put — a blob larger than the whole budget must
+still be servable at least once, or a big migration chunk could never
+leave the source. A dropped export only costs the importer a fallback
+to local (re-)prefill (token-identical by the determinism contract),
+never correctness; the eviction counter
+(``kubeinfer_kv_export_evictions_total``) is what tells an operator a
+slow importer is losing blobs between chunks.
 """
 
 from __future__ import annotations
@@ -32,12 +40,17 @@ DEFAULT_EXPORT_CAPACITY = 32
 class KVExportCache:
     """Bounded LRU of wire-encoded KV exports keyed by fingerprint."""
 
-    def __init__(self, capacity: int = DEFAULT_EXPORT_CAPACITY) -> None:
+    def __init__(self, capacity: int = DEFAULT_EXPORT_CAPACITY,
+                 max_bytes: int | None = None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes) if max_bytes is not None else None
         self._lock = make_lock("disagg.KVExportCache._lock")
         self._entries: OrderedDict[int, bytes] = OrderedDict()
+        self._bytes = 0
         self.puts = 0
         self.hits = 0
         self.misses = 0
@@ -46,11 +59,22 @@ class KVExportCache:
 
     def put(self, fingerprint: int, blob: bytes) -> None:
         with self._lock:
+            old = self._entries.pop(int(fingerprint), None)
+            if old is not None:
+                self._bytes -= len(old)
             self._entries[int(fingerprint)] = blob
-            self._entries.move_to_end(int(fingerprint))
+            self._bytes += len(blob)
             self.puts += 1
-            while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+            # the len > 1 guard keeps the entry just put: a blob bigger
+            # than the whole budget must still be servable once, else a
+            # large migration chunk could never leave this replica
+            while len(self._entries) > self.capacity or (
+                self.max_bytes is not None
+                and self._bytes > self.max_bytes
+                and len(self._entries) > 1
+            ):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= len(dropped)
                 self.evictions += 1
 
     def get(self, fingerprint: int) -> bytes | None:
@@ -72,6 +96,8 @@ class KVExportCache:
             return {
                 "entries": len(self._entries),
                 "capacity": self.capacity,
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
                 "puts": self.puts,
                 "hits": self.hits,
                 "misses": self.misses,

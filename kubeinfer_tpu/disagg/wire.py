@@ -26,6 +26,19 @@ an unknown header field mid-rollout. Decoders accept both versions;
 dtype agreement is the IMPORTER's policy call (client.py), not a wire
 error: a v1 blob is a valid payload that an int8 engine must decline,
 not corruption.
+
+Version 3 (``kubeinfer-kvwire/3``) adds ``start_block`` for CHUNKED
+transfers (live-session migration): the payload's pages cover blocks
+``[start_block, start_block + blocks)`` of a longer chain, and its
+fingerprints are that SLICE of the chain — each one still rolls over
+the full prefix from token 0, so a chunk is only importable on top of
+the exact prefix it continues. Deliberately no total-blocks field: the
+importer computes the full chain from its own tokens and verifies the
+slice against it; a header field would just be a second, spoofable
+copy. Chunk 0 of a chunked stream has ``start_block == 0`` and encodes
+as plain v1/v2 (decoders default the field to 0), so the v1
+byte-identity pin and every pre-v3 importer keep working; the v3 magic
+appears on the wire only when a nonzero offset does.
 """
 
 from __future__ import annotations
@@ -38,6 +51,7 @@ import numpy as np
 
 _MAGIC = "kubeinfer-kvwire/1"
 _MAGIC_V2 = "kubeinfer-kvwire/2"
+_MAGIC_V3 = "kubeinfer-kvwire/3"
 
 # Header stays a bounded parse even against a hostile peer: fingerprint
 # lists are capped by pool size in practice (blocks <= num_blocks), but
@@ -64,6 +78,9 @@ class KVBlockPayload:
     kv_dtype: str = "bf16"
     scales_k: np.ndarray | None = None
     scales_v: np.ndarray | None = None
+    # v3 field: first block's offset in the full chain this chunk
+    # continues (0 = the chain's head, which also encodes as v1/v2)
+    start_block: int = 0
 
     @property
     def blocks(self) -> int:
@@ -101,7 +118,10 @@ def encode_payload(
     scales_k: np.ndarray | None = None,
     scales_v: np.ndarray | None = None,
     kv_dtype: str = "bf16",
+    start_block: int = 0,
 ) -> bytes:
+    if start_block < 0:
+        raise WireError(f"start_block must be >= 0, got {start_block}")
     if pages_k.shape != pages_v.shape or pages_k.dtype != pages_v.dtype:
         raise WireError(
             f"K/V pages disagree: {pages_k.shape}/{pages_k.dtype} vs "
@@ -152,6 +172,13 @@ def encode_payload(
         body += scales_k.tobytes() + scales_v.tobytes()
         header["magic"] = _MAGIC_V2
         header["kv_dtype"] = kv_dtype
+    if start_block:
+        # v3 only when the offset carries information: chunk 0 and
+        # whole-prefix exports keep the v1/v2 magic (and the v1
+        # byte-identity pin) — decoders default start_block to 0
+        header["magic"] = _MAGIC_V3
+        header["kv_dtype"] = kv_dtype
+        header["start_block"] = int(start_block)
     header["body_bytes"] = len(body)
     header["body_sha256"] = hashlib.sha256(body).hexdigest()
     return json.dumps(header).encode() + b"\n" + body
@@ -168,9 +195,9 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
     if not isinstance(header, dict):
         raise WireError("header is not an object")
     magic = header.get("magic")
-    if magic not in (_MAGIC, _MAGIC_V2):
+    if magic not in (_MAGIC, _MAGIC_V2, _MAGIC_V3):
         raise WireError(f"bad magic {magic!r}")
-    v2 = magic == _MAGIC_V2
+    v3 = magic == _MAGIC_V3
     body = blob[nl + 1:]
     try:
         layers = int(header["layers"])
@@ -181,11 +208,22 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
         body_bytes = int(header["body_bytes"])
         want_sha = str(header["body_sha256"])
         dtype = _resolve_dtype(str(header["dtype"]))
-        kv_dtype = str(header["kv_dtype"]) if v2 else "bf16"
+        kv_dtype = (
+            str(header["kv_dtype"])
+            if magic != _MAGIC else "bf16"
+        )
+        start_block = int(header["start_block"]) if v3 else 0
     except (KeyError, TypeError, ValueError) as e:
         raise WireError(f"malformed header: {e}") from e
-    if v2 and kv_dtype == "bf16":
+    if magic == _MAGIC_V2 and kv_dtype == "bf16":
         raise WireError("v2 header claims bf16 — scales make no sense")
+    if v3 and start_block <= 0:
+        # a zero-offset v3 blob would be a second spelling of v1/v2
+        # bytes for the same payload, splitting the content address
+        raise WireError("v3 start_block must be > 0 (chunk 0 is v1/v2)")
+    # scales ride iff the pool is quantized — v3 carries them under the
+    # same rule as v2 (kv_dtype names the pool, bf16 chunks have none)
+    scaled = kv_dtype != "bf16"
     if len(page_shape) != 3 or page_shape[0] != block_size:
         raise WireError(
             f"page_shape {page_shape} inconsistent with "
@@ -207,7 +245,7 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
         )
     per_side = layers * blocks * int(np.prod(page_shape)) * dtype.itemsize
     n_kv = page_shape[1]
-    per_scale = layers * blocks * n_kv * 4 if v2 else 0
+    per_scale = layers * blocks * n_kv * 4 if scaled else 0
     if len(body) != 2 * per_side + 2 * per_scale:
         raise WireError(
             f"body is {len(body)} bytes, header shapes imply "
@@ -218,7 +256,7 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
     pages_v = np.frombuffer(
         body[per_side:2 * per_side], dtype=dtype).reshape(shape)
     scales_k = scales_v = None
-    if v2:
+    if scaled:
         sshape = (layers, blocks, n_kv)
         off = 2 * per_side
         scales_k = np.frombuffer(
@@ -229,4 +267,5 @@ def decode_payload(blob: bytes) -> KVBlockPayload:
         pages_k=pages_k, pages_v=pages_v,
         fingerprints=fingerprints, block_size=block_size,
         kv_dtype=kv_dtype, scales_k=scales_k, scales_v=scales_v,
+        start_block=start_block,
     )

@@ -55,6 +55,7 @@ from kubeinfer_tpu.inference.kv_blocks import (
     BlockPool,
     RadixCache,
     dequantize_blocks,
+    prefix_fingerprints,
     quantize_blocks,
 )
 from kubeinfer_tpu.analysis.racecheck import guard, make_lock
@@ -507,6 +508,14 @@ def _import_blocks(
 # --- host-side scheduler ---------------------------------------------------
 
 
+class EngineDrainingError(RuntimeError):
+    """submit() refused because the engine is draining. Its own type
+    (not ValueError) so the server can answer 503 — the request is
+    valid, THIS replica just won't take it — and the router can treat
+    the refusal as 'mark draining, route elsewhere' rather than a
+    client error to relay."""
+
+
 @dataclass(frozen=True)
 class PreemptionPolicy:
     """SLO-aware preemption knobs (vLLM preempts by full recompute; the
@@ -600,6 +609,15 @@ class _Request:
     # edge, same contract as the timeline fields above).
     export_kv: bool = False
     kv_export: dict | None = None
+    # live-session migration (drain): set INSTEAD of a normal
+    # completion when the engine handed this session off — carries the
+    # generation-so-far plus how many committed blocks were streamed to
+    # the export cache, so the router can re-route with a resume body.
+    # Read by the server after done is set (same happens-before
+    # contract as kv_export above). A migrated request is neither
+    # finished nor failed: its out_tokens are a PREFIX of the final
+    # answer, which the resuming replica completes token-identically.
+    migrated: dict | None = None
 
     @property
     def pending_since(self) -> float:
@@ -652,6 +670,12 @@ class _ImportTask:
     # [L, n, n_kv] f32; None on the bf16 wire
     scales_k: np.ndarray | None = None
     scales_v: np.ndarray | None = None
+    # chunked import (kubeinfer-kvwire/3, live migration): the pages
+    # cover blocks [start_block, start_block + n) and ``tokens`` the
+    # whole prefix through the chunk's end — the scatter stacks on a
+    # radix-matched [0, start_block) prefix, so a chunk can never land
+    # on the wrong base
+    start_block: int = 0
     done: threading.Event = field(default_factory=threading.Event)
     imported: int = 0
     reason: str | None = None
@@ -685,7 +709,8 @@ class ContinuousEngine:
                  layout: EngineLayout | None = None,
                  spec_draft: tuple[Params, ModelConfig] | None = None,
                  spec_k: int = 4,
-                 kv_dtype: str = "bf16") -> None:
+                 kv_dtype: str = "bf16",
+                 migration_chunk_blocks: int = 4) -> None:
         # device layout (sharding.EngineLayout): tp=1 (the default) is
         # meshless and every placement below is the identity — the
         # engine is byte-for-byte the single-device engine. Under tp>1
@@ -783,6 +808,32 @@ class ContinuousEngine:
         self._imports: list[_ImportTask] = []
         self.imports_total = 0  # telemetry: serviced KV imports
         self.imported_blocks_total = 0  # telemetry: blocks scattered in
+        # live-session migration (drain): while _draining, submit()
+        # refuses, pending populations complete as migrated, and live
+        # slots stream their committed blocks out through
+        # migration_sink one chunk per scheduler pass (decode keeps
+        # running between chunks), then park-and-migrate the tail.
+        # _draining is read locklessly on hot paths (same torn-read
+        # tolerance as stats_summary — a racing submit lands in the
+        # queue and the next drain sweep migrates it).
+        if migration_chunk_blocks < 1:
+            raise ValueError(
+                f"migration_chunk_blocks must be >= 1, got "
+                f"{migration_chunk_blocks}"
+            )
+        self.migration_chunk_blocks = migration_chunk_blocks
+        self._draining = False
+        self._drained = threading.Event()
+        # injectable export hook, set by the serving layer: called on
+        # the scheduler thread OFF _lock with one chunk dict
+        # (start_block, pages, fingerprints slice, scales for int8) —
+        # the server encodes wire v3 and parks it in its KVExportCache
+        self.migration_sink = None
+        # per-slot count of committed blocks already streamed out
+        self._migrate_cursor: dict[int, int] = {}
+        self.migrated_total = 0  # telemetry: sessions handed off
+        self.migration_chunks_total = 0  # telemetry: chunks streamed
+        self.migration_blocks_total = 0  # telemetry: blocks streamed
         # cooldown ticks on decode steps; start past the gate so the
         # first pressure spike can preempt immediately
         self._steps_since_preempt = 1 << 30
@@ -937,7 +988,25 @@ class ContinuousEngine:
                seed: int = 0, top_k: int = 0,
                top_p: float = 1.0,
                repetition_penalty: float = 1.0,
-               export_kv: bool = False) -> _Request:
+               export_kv: bool = False,
+               resume_tokens: list[int] | None = None) -> _Request:
+        """``resume_tokens`` is the migration resume path: tokens a
+        SOURCE replica already generated for this request. They
+        pre-populate ``out_tokens``, so admission takes the readmit
+        route (effective prompt = prompt + resume_tokens, remaining
+        budget = max_new - len(resume_tokens)) and — by the
+        position-folded key schedule that makes park/readmit exact —
+        every later sample draws the identical noise an uninterrupted
+        run would have at that position. ``max_new_tokens`` stays the
+        ORIGINAL total budget, exactly as a parked request keeps its
+        own; the returned out_tokens therefore contains resume_tokens
+        as a prefix of the full answer."""
+        if self._draining:
+            # lockless read, same torn-read tolerance as stats_summary:
+            # a submit racing the flag flip lands in the queue and the
+            # next _step_drain sweep migrates it — refused here only as
+            # a fast path so the router marks this replica early
+            raise EngineDrainingError("engine is draining")
         if not prompt:
             raise ValueError("empty prompt")
         if not self.fits(len(prompt), max_new_tokens):
@@ -949,10 +1018,32 @@ class ContinuousEngine:
                 f"prefill bucket {_bucket(len(prompt))}) exceeds slot "
                 f"capacity ({self.cache_len})"
             )
+        rt = [int(t) for t in (resume_tokens or [])]
+        if rt:
+            if len(rt) >= max_new_tokens:
+                # a fully (or over-) generated resume has nothing left
+                # to decode; admitting it would sample past the budget
+                raise ValueError(
+                    f"resume_tokens ({len(rt)}) must leave budget "
+                    f"(max_new {max_new_tokens})"
+                )
+            if _bucket(len(prompt) + len(rt)) > self.cache_len:
+                # the readmit's effective prompt pads to a bucket just
+                # like a cold admit; same silent-empty-completion guard
+                # as fits() applies to the widened prompt
+                raise ValueError(
+                    f"resume bucket {_bucket(len(prompt) + len(rt))} "
+                    f"exceeds slot capacity ({self.cache_len})"
+                )
         req = _Request(prompt, max_new_tokens, eos_id,
                        temperature=temperature, top_k=top_k, top_p=top_p,
                        rep_penalty=repetition_penalty, seed=seed,
                        export_kv=export_kv)
+        if rt:
+            # the admit path detects a resume by out_tokens being
+            # non-empty (exactly how a parked readmit looks); no
+            # token_times for these — they were timed on the source
+            req.out_tokens = rt
         # capture the submitter's trace context here (scheduler runs on
         # its own thread, where the thread-local stack is empty); no
         # inbound context still gets a per-request trace anchor
@@ -970,15 +1061,20 @@ class ContinuousEngine:
               seed: int = 0, top_k: int = 0, top_p: float = 1.0,
               repetition_penalty: float = 1.0,
               timeout: float = 300.0,
-              export_kv: bool = False) -> _Request:
+              export_kv: bool = False,
+              resume_tokens: list[int] | None = None) -> _Request:
         """submit() + wait, returning the completed request object so
         callers (the HTTP server's latency-breakdown histograms) can
-        read the timeline fields alongside the tokens."""
+        read the timeline fields alongside the tokens. A request that
+        completes by MIGRATION (this replica drained mid-generation)
+        returns normally with ``req.migrated`` set — the caller decides
+        whether to re-route with the partial out_tokens."""
         req = self.submit(prompt, max_new_tokens, eos_id,
                           temperature=temperature, seed=seed,
                           top_k=top_k, top_p=top_p,
                           repetition_penalty=repetition_penalty,
-                          export_kv=export_kv)
+                          export_kv=export_kv,
+                          resume_tokens=resume_tokens)
         if not req.done.wait(timeout):
             req.cancel()  # free the slot; tokens would go unread
             raise TimeoutError("generation timed out")
@@ -996,6 +1092,45 @@ class ContinuousEngine:
             seed=seed, top_k=top_k, top_p=top_p,
             repetition_penalty=repetition_penalty, timeout=timeout,
         ).out_tokens
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Flip the engine into drain mode: submit() starts refusing,
+        and the scheduler loop replaces admission/preemption with
+        ``_step_drain`` — pending populations complete as migrated
+        immediately, live slots stream their committed KV out through
+        ``migration_sink`` one chunk per pass (decode keeps running
+        between chunks — the stream chases the decode head), and each
+        caught-up slot parks-for-migrate. Idempotent; ``undrain()``
+        reverses it (the rebalance caller drains, hands sessions off,
+        then rejoins the fleet)."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._drained.clear()
+        self._note("drain_start")
+
+    def undrain(self) -> None:
+        """Resume admissions after a drain (rebalance / cancelled
+        scale-down). Sessions already migrated are gone — a bounced-back
+        request re-enters through submit(resume_tokens=...) and lands
+        warm on the blocks ``_migrate_slot`` parked in the trie."""
+        with self._lock:
+            if not self._draining:
+                return
+            self._draining = False
+            self._drained.clear()
+        self._note("drain_end")
+
+    def wait_drained(self, timeout_s: float = 30.0) -> bool:
+        """Block until every live session has reached a terminal state
+        (done, failed, cancelled, or migrated) and the arrival queue is
+        empty. Only meaningful while draining."""
+        return self._drained.wait(timeout_s)
 
     def kv_cache_stats(self) -> dict:
         """Point-in-time paged-KV accounting for /metrics: pool
@@ -1023,7 +1158,8 @@ class ContinuousEngine:
                       timeout_s: float = 10.0,
                       scales_k: np.ndarray | None = None,
                       scales_v: np.ndarray | None = None,
-                      kv_dtype: str = "bf16") -> tuple[int, str | None]:
+                      kv_dtype: str = "bf16",
+                      start_block: int = 0) -> tuple[int, str | None]:
         """Land a remotely prefilled prefix in the local pool + radix
         cache (disaggregated prefill, disagg/). Callable from any
         thread: the scatter is staged for the scheduler thread — the
@@ -1037,7 +1173,16 @@ class ContinuousEngine:
         ``pages_k``/``pages_v`` be ``[L, n, block_size, n_kv, D]`` in
         the cache dtype — the caller (disagg.client) has already
         verified the fingerprint chain, so a shape mismatch here means
-        a mis-configured fleet, not corruption."""
+        a mis-configured fleet, not corruption.
+
+        ``start_block`` supports CHUNKED imports (wire v3, live-session
+        migration): the pages cover blocks ``[start_block, start_block
+        + n)`` of ``tokens``, and the first ``start_block`` blocks must
+        already be in the radix cache (landed by the previous chunks) —
+        a chunk whose base prefix was evicted between chunks fails with
+        ``missing_prefix`` rather than caching a chain with a hole."""
+        if start_block < 0:
+            return 0, "shape_mismatch"
         if kv_dtype != self.kv_dtype:
             # cross-dtype pages are structurally unusable (an int8 page
             # without its scales, or bf16 pages a quantized pool would
@@ -1048,8 +1193,8 @@ class ContinuousEngine:
         if pages_k.ndim != 5 or pages_k.shape != pages_v.shape:
             return 0, "shape_mismatch"
         n = int(pages_k.shape[1])
-        if n == 0 or n > self.max_blocks or \
-                len(tokens) != n * self.block_size:
+        if n == 0 or start_block + n > self.max_blocks or \
+                len(tokens) != (start_block + n) * self.block_size:
             return 0, "shape_mismatch"
         if kv_dtype == "int8":
             want_s = (pages_k.shape[0], n, pages_k.shape[3])
@@ -1062,7 +1207,8 @@ class ContinuousEngine:
         if self._stop.is_set() or self._thread is None:
             return 0, "stopped"
         task = _ImportTask(list(tokens), pages_k, pages_v,
-                           scales_k=scales_k, scales_v=scales_v)
+                           scales_k=scales_k, scales_v=scales_v,
+                           start_block=start_block)
         with self._lock:
             self._imports.append(task)
         self._note("import_staged", blocks=n)
@@ -1115,8 +1261,33 @@ class ContinuousEngine:
         # trie/pool mutations take _lock (HTTP threads walk the trie in
         # cache_summary); the jit scatter between them stays OFF-lock —
         # only this thread allocs, so the two sections can't interleave
+        start = task.start_block
         with self._lock:
+            shared: list[int] = []
+            if start:
+                # chunked import (wire v3): this chunk stacks on the
+                # blocks the previous chunks inserted. The trie walk
+                # refs its matches (ours until the final insert/unref
+                # below); fewer matches than start_block means the base
+                # was evicted between chunks — reject rather than cache
+                # a chain with a hole, the importer restarts the prefix
+                matched = self._radix.match(
+                    task.tokens[: start * self.block_size]
+                )
+                if len(matched) < start:
+                    if matched:
+                        self._pool.unref(matched)
+                    task.reason = "missing_prefix"
+                    self._note("import_reject", blocks=n,
+                               reason=task.reason)
+                    task.done.set()
+                    return
+                shared = matched[:start]
+                if len(matched) > start:
+                    self._pool.unref(matched[start:])
             if not self._radix.ensure_free(n):
+                if shared:
+                    self._pool.unref(shared)
                 task.reason = "backpressure"
                 self._note("import_reject", blocks=n, reason=task.reason)
                 task.done.set()
@@ -1144,12 +1315,17 @@ class ContinuousEngine:
             jnp.asarray(sk), jnp.asarray(sv),
         )
         with self._lock:
-            created = self._radix.insert(task.tokens, fresh)
-            self._pool.unref(fresh)
+            # the insert covers the WHOLE chain so far (shared base +
+            # this chunk); the trie takes its own reference per block
+            # and both our holds return here, leaving the chain at
+            # trie-only refcount — LRU-evictable like any parked prefix
+            created = self._radix.insert(task.tokens, shared + fresh)
+            self._pool.unref(shared + fresh)
         self.imports_total += 1
         self.imported_blocks_total += n
         task.imported = n
-        self._note("import", blocks=n, created_nodes=created)
+        self._note("import", blocks=n, created_nodes=created,
+                   start_block=start)
         task.done.set()
 
     def scheduler_stats(self) -> dict:
@@ -1176,6 +1352,11 @@ class ContinuousEngine:
             # disaggregated prefill: serviced imports / blocks landed
             "kv_imports": self.imports_total,
             "kv_imported_blocks": self.imported_blocks_total,
+            # live-session migration: sessions handed off, chunks and
+            # blocks streamed out (drain/evacuate/rebalance paths)
+            "migrated": self.migrated_total,
+            "migration_chunks": self.migration_chunks_total,
+            "migration_blocks": self.migration_blocks_total,
         }
 
     def _note(self, kind: str, **detail) -> None:
@@ -1192,6 +1373,21 @@ class ContinuousEngine:
             kv_free=self._pool.free_blocks,
             **detail,
         )
+
+    def slo_burn(self) -> float:
+        """Worst burn rate across every objective and window — the
+        scalar the reconciler's evacuation pass thresholds on (a
+        replica persistently burning error budget gets drained before
+        it starts failing requests outright). 0.0 without an SLO
+        monitor or without traffic; callable from any thread."""
+        if self._slo is None:
+            return 0.0
+        rates = self._slo.burn_rates()
+        worst = 0.0
+        for per_window in rates.values():
+            for rate in per_window.values():
+                worst = max(worst, float(rate))
+        return worst
 
     def stats_summary(self, window_s: float = 60.0) -> dict:
         """One-dict replica serving summary for the node agent's
@@ -1232,6 +1428,11 @@ class ContinuousEngine:
                 kv["hits"] / lookups if lookups else 0.0, 6
             ),
             "prefix_cached_tokens": kv["cached_tokens"],
+            # drain awareness for the router (skip for new work) and
+            # the reconciler's evacuation trigger — both ride the same
+            # heartbeat this dict feeds
+            "draining": bool(self._draining),
+            "slo_burn": round(self.slo_burn(), 6),
             # the router's prefix-affinity signal, already capped at
             # kv_blocks.SUMMARY_FINGERPRINT_BUDGET so a big trie cannot
             # bloat the store write this dict rides in (the node agent
@@ -1697,7 +1898,12 @@ class ContinuousEngine:
         if req.max_new > 0:
             req.out_tokens.append(first)
             req.token_times.append(now)
-        if not task.resumed:
+        # a preemption readmit keeps the stamp from its original admit,
+        # but a server-level resume (migration hand-off) never had one
+        # in THIS engine — without the stamp here the server's TTFT
+        # breakdown degrades to whole-request duration and the
+        # import-vs-reprefill comparison measures the decode tail
+        if not req.t_first:
             req.t_first = now
         # one profiler record per prefill dispatch, bracketing the
         # _admit_slot call + its host sync above. The dispatch's one
@@ -1749,6 +1955,10 @@ class ContinuousEngine:
         if finished:
             self._slot_req[slot] = None
             self._slot_spec_ok[slot] = False
+            # a drain may have been streaming this slot; the slot id is
+            # about to be reusable, and a stale cursor would make a
+            # later drain stream the wrong blocks
+            self._migrate_cursor.pop(slot, None)
             blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
             if blocks:
                 # drop the slot's hold; blocks also cached in the trie
@@ -1819,6 +2029,7 @@ class ContinuousEngine:
             self._radix.insert(committed, blocks[:full])
         self._slot_req[slot] = None
         self._slot_spec_ok[slot] = False
+        self._migrate_cursor.pop(slot, None)  # slot id becomes reusable
         if blocks:
             self._pool.unref(blocks)
         self._state = dataclasses.replace(
@@ -1835,6 +2046,196 @@ class ContinuousEngine:
         self._parked.append(req)
         self._note("preempt", slot=slot, tokens=len(req.out_tokens),
                    cached_blocks=full, parked=len(self._parked))
+
+    # -- live-session migration (drain) -----------------------------------
+
+    def _mark_migrated(self, req: "_Request", streamed: int) -> None:
+        """Complete ``req`` as MIGRATED: the terminal state a drained
+        session reaches instead of done/failed. The waiter wakes with
+        ``req.migrated`` set and out_tokens a PREFIX of the final
+        answer — the serving layer re-routes with those tokens as the
+        resume prefix, and token identity across the hop is the same
+        position-folded-key invariant park/readmit pins. Scheduler
+        thread only; safe with or without the lock (mutates only the
+        request and monotonic counters)."""
+        req.migrated = {
+            "tokens": list(req.out_tokens),
+            "blocks": int(streamed),
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+        }
+        req.t_done = tracing.now()
+        self.migrated_total += 1
+        self._note("migrate", tokens=len(req.out_tokens),
+                   blocks=streamed)
+        req.done.set()
+
+    def _migrate_slot(self, slot: int, req: "_Request",
+                      streamed: int) -> None:
+        """Park-for-migrate: release ``slot`` exactly like ``_park_slot``
+        — committed full blocks go into the radix trie — but the
+        request completes as migrated instead of joining ``_parked``.
+        The trie insert is load-bearing for the fallback story: if the
+        router bounces the session back here (target died, or this was
+        a rebalance and we undrain), the resume admit radix-matches
+        these exact blocks and costs only the tail recompute."""
+        with self._lock:
+            if self._slot_req[slot] is not req:
+                return  # retired or cancelled since the snapshot
+            toks = req.prompt + req.out_tokens
+            blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+            # same committed-blocks rule as _park_slot: the last
+            # token's KV is uncommitted, so only blocks fully inside
+            # [0, len-1) may be cached (or streamed — the export cursor
+            # obeys the identical bound)
+            committed = toks[:-1]
+            full = len(committed) // self.block_size
+            if full:
+                self._radix.insert(committed, blocks[:full])
+            self._slot_req[slot] = None
+            self._slot_spec_ok[slot] = False
+            self._migrate_cursor.pop(slot, None)
+            if blocks:
+                self._pool.unref(blocks)
+            self._state = dataclasses.replace(
+                self._state,
+                active=self._state.active.at[slot].set(False),
+                tables=self._state.tables.at[slot].set(0),
+            )
+        self._mark_migrated(req, streamed)
+
+    def _step_drain(self) -> None:
+        """One drain pass (scheduler thread only): sweep every queued
+        population to a terminal state, then advance at most ONE live
+        slot — stream one chunk of its committed blocks through
+        ``migration_sink``, or park-and-migrate it once the stream has
+        caught up with the decode head. One chunk per pass is the same
+        quantum as chunked prefill and KV import: the decode windows
+        between chunks keep emitting tokens — that interleave is what
+        'migrate while decoding' means, and the catch-up always
+        terminates because a pass streams chunk_blocks * block_size
+        token positions while decode advances at most one window."""
+        # never-admitted work first: it holds no KV, so 'migrating' it
+        # is just handing the request (plus any resume prefix) back to
+        # the router for placement elsewhere
+        with self._lock:
+            pending: list[_Request] = list(self._holdover)
+            self._holdover.clear()
+            pending.extend(self._parked)
+            self._parked.clear()
+            while True:
+                try:
+                    pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            staged, self._staged = self._staged, []
+            for req, _slot, kv_plan, _tokens in staged:
+                # release the plan's block holds (same bookkeeping as
+                # the cancelled-staged path in _admit_pending)
+                table_row, _own, _reuse, total, _spec = kv_plan
+                self._pool.unref([int(b) for b in table_row[:total]])
+                pending.append(req)
+        for req in pending:
+            if req.cancelled.is_set():
+                req.t_done = tracing.now()
+                req.done.set()
+                continue
+            self._mark_migrated(req, streamed=0)
+        sink = self.migration_sink
+        stream = None  # (slot, req, toks, cursor, n, blocks)
+        final = None  # (slot, req, cursor)
+        with self._lock:
+            # mid-prefill rows keep prefilling (they become decoding
+            # rows in a pass or two); cancelled rows retire at the next
+            # window boundary — neither is a migration candidate yet
+            prefilling = {t.slot for t in self._prefills}
+            for slot, req in enumerate(self._slot_req):
+                if req is None or slot in prefilling \
+                        or req.cancelled.is_set():
+                    continue
+                toks = req.prompt + req.out_tokens
+                committed = (len(toks) - 1) // self.block_size
+                cursor = self._migrate_cursor.get(slot, 0)
+                if sink is not None and cursor < committed:
+                    n = min(self.migration_chunk_blocks,
+                            committed - cursor)
+                    stream = (slot, req, toks, cursor, n, list(
+                        self._slot_blocks[slot][cursor:cursor + n]
+                    ))
+                else:
+                    # caught up (or no sink is wired — then nothing
+                    # streams and the target resumes by re-prefill,
+                    # warm off the trie insert if it lands back here)
+                    final = (slot, req, cursor)
+                break
+        if stream is not None:
+            slot, req, toks, cursor, n, blocks = stream
+            bs = self.block_size
+            # page capture off the lock: only this thread writes
+            # _state, so the gather cannot race a donation; same
+            # boundary as _finalize_admit's export capture
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            pages_k = np.stack([
+                np.asarray(ck[idx]) for ck in self._state.caches_k
+            ])
+            pages_v = np.stack([
+                np.asarray(cv[idx]) for cv in self._state.caches_v
+            ])
+            # fingerprints recomputed from the tokens, not read from
+            # the trie: the streamed blocks are slot-held (not yet
+            # inserted), and the chain from token 0 is exactly what the
+            # importer recomputes to verify the slice
+            fps = prefix_fingerprints(toks[:(cursor + n) * bs], bs)
+            chunk = {
+                "start_block": cursor,
+                "pages_k": pages_k,
+                "pages_v": pages_v,
+                "fingerprints": fps[cursor:cursor + n],
+                "block_size": bs,
+                "kv_dtype": self.kv_dtype,
+            }
+            if self.kv_dtype == "int8":
+                # committed blocks are already quantized (window-
+                # boundary commit), so the scales travel with the chunk
+                chunk["scales_k"] = np.stack([
+                        np.asarray(sk[idx]) for sk in self._state.scales_k
+                ])
+                chunk["scales_v"] = np.stack([
+                        np.asarray(sv[idx]) for sv in self._state.scales_v
+                ])
+            try:
+                sink(chunk)
+            except Exception:  # noqa: BLE001 — sink is injected code
+                # a broken sink must not wedge the drain: hand the
+                # session off with what was already streamed; the
+                # target re-prefills the rest from the last verified
+                # chunk (or from scratch), token-identical either way
+                self._note("migrate_sink_error", slot=slot,
+                           start_block=cursor)
+                self._migrate_slot(slot, req, cursor)
+                return
+            with self._lock:
+                self._migrate_cursor[slot] = cursor + n
+                self.migration_chunks_total += 1
+                self.migration_blocks_total += n
+            self._note("migrate_chunk", slot=slot, start_block=cursor,
+                       blocks=n)
+            return
+        if final is not None:
+            slot, req, cursor = final
+            self._migrate_slot(slot, req, cursor)
+            return
+        # nothing to advance: drained once every population is empty
+        # (spec groups and prefills finish through their own steppers)
+        with self._lock:
+            live = (
+                any(r is not None for r in self._slot_req)
+                or self._spec_group is not None
+                or bool(self._holdover) or bool(self._parked)
+                or bool(self._prefills) or bool(self._staged)
+            )
+        if not live and self._queue.empty():
+            self._drained.set()
 
     def _pick_victim(self, pol: PreemptionPolicy) -> int | None:
         """Lowest-priority preemptable row: the YOUNGEST-arrival active
@@ -2252,6 +2653,13 @@ class ContinuousEngine:
                         and not self._parked)
                 have_holdover = bool(self._holdover)
             if idle:
+                if self._draining:
+                    # drain sweeps the queue itself (racing submits
+                    # land there past the lockless refusal) and flips
+                    # _drained once every population is empty
+                    self._step_drain()
+                    self._stop.wait(0.05)
+                    continue
                 # fully idle: block briefly for the next arrival
                 if not have_holdover:
                     try:
@@ -2270,8 +2678,15 @@ class ContinuousEngine:
             # interleave is the tentpole: prefill stopped being one
             # atomic dispatch and became schedulable work competing
             # with decode under an explicit policy.
-            self._admit_pending()
-            self._maybe_preempt()
+            if self._draining:
+                # admission and preemption stand down; the drain pass
+                # streams one chunk (or finalizes one caught-up slot)
+                # and the decode window below keeps the batch emitting
+                # tokens between chunks
+                self._step_drain()
+            else:
+                self._admit_pending()
+                self._maybe_preempt()
             with self._lock:
                 # mid-prefill rows are reserved but not yet decoding
                 # (active=False, null tables); they are padding in the
@@ -2310,6 +2725,10 @@ class ContinuousEngine:
             # of K=1 or one window of delayed admission — never
             # correctness
             host_work = host_work or not self._queue.empty()
+            # draining forces K=1: short windows keep the chunk stream
+            # close behind the decode head, so the park-and-move tail
+            # (and the drain itself) lands sooner
+            host_work = host_work or self._draining
             if decode_rows and spec_ready:
                 # the speculative twin of the fused branch below: one
                 # verify dispatch advances every row by 1..spec_k+1
@@ -2325,7 +2744,10 @@ class ContinuousEngine:
                     self._dstate, self.cfg, self._dcfg, self.spec_k,
                     sharded=self._sharded,
                 )
-                self._plan_admissions()
+                if not self._draining:
+                    # a drain must not stage new plans (their block
+                    # holds would just be unwound by the next sweep)
+                    self._plan_admissions()
                 # lint: allow[host-sync] window boundary: the [n_slots, spec_k+1] token matrix feeds the Python result queues
                 toks = np.asarray(tokens)
                 step_t = tracing.now()
@@ -2411,7 +2833,10 @@ class ContinuousEngine:
                 # dispatch): the admission planning below is the host
                 # work overlapped with the device window, and the
                 # readback after it is the one synchronization point
-                self._plan_admissions()
+                if not self._draining:
+                    # same stand-down as the verify branch: no new
+                    # plans while draining
+                    self._plan_admissions()
                 # lint: allow[host-sync] window boundary: the [n_slots, k] token matrix feeds the Python result queues
                 toks = np.asarray(tokens)
                 # one clock read per WINDOW, outside the lock: token

@@ -330,6 +330,43 @@ def _serving_metrics(registry: Registry):
             "plus decode/verify boundary crossings; imports excluded)",
             registry=registry,
         ),
+        # live-session migration (drain/evacuate/rebalance): sessions
+        # handed off with a resume prefix, chunks streamed while decode
+        # continued, and the export-cache evictions that tell an
+        # operator a slow importer is losing blobs between chunks.
+        # Fallbacks are the paths that degraded to (partial) re-prefill
+        # — token-identical by the determinism contract, so every one
+        # is a latency event, never a correctness one.
+        "migrations": Counter(
+            "kubeinfer_migrations_total",
+            "Live sessions completed as migrated (drain handed them to "
+            "the router with a resume prefix)",
+            registry=registry,
+        ),
+        "migration_chunks": Counter(
+            "kubeinfer_migration_chunks_total",
+            "KV chunks streamed out by drain passes while decode "
+            "continued on the source",
+            registry=registry,
+        ),
+        "migration_fallbacks": Counter(
+            "kubeinfer_migration_fallbacks_total",
+            "Migration resume paths that degraded to (partial) local "
+            "re-prefill, by reason",
+            labels=("reason",), registry=registry,
+        ),
+        "kv_export_evictions": Counter(
+            "kubeinfer_kv_export_evictions_total",
+            "Export-cache blobs evicted (entry cap or bytes budget) "
+            "before being pulled",
+            registry=registry,
+        ),
+        "draining": Gauge(
+            "kubeinfer_engine_draining_state",
+            "1 while the engine refuses new admissions (drain in "
+            "progress)",
+            registry=registry,
+        ),
     }
 
 
@@ -338,7 +375,8 @@ class InferenceServer:
                  host: str = "127.0.0.1", port: int = 8000,
                  continuous=None, speculative=None, sp=None,
                  tls_cert: str = "", tls_key: str = "",
-                 token: str = "", slo=None) -> None:
+                 token: str = "", slo=None,
+                 kv_export_budget_mb: float = 0.0) -> None:
         self.engine = engine
         self.continuous = continuous  # ContinuousEngine | None
         self.speculative = speculative  # SpeculativeEngine | None
@@ -363,7 +401,22 @@ class InferenceServer:
         if continuous is not None:
             from kubeinfer_tpu.disagg.export import KVExportCache
 
-            self.kv_exports = KVExportCache()
+            # --kv-export-budget-mb: migration chunks are much larger
+            # than prefill exports, so the cache is byte-bounded too
+            # (0 = entry cap only, the pre-migration behavior)
+            self.kv_exports = KVExportCache(
+                max_bytes=(
+                    int(kv_export_budget_mb * (1 << 20))
+                    if kv_export_budget_mb > 0 else None
+                ),
+            )
+            # live-session migration: the engine's drain pass streams
+            # committed-KV chunks through this sink (scheduler thread,
+            # off the engine lock); they land in the same export cache
+            # /kv/blocks already serves, keyed by each chunk's own
+            # deepest fingerprint — the target's chunked importer needs
+            # no new endpoint
+            continuous.migration_sink = self._export_migration_chunk
         # last-seen monotonic kv_cache_stats counters, for the
         # delta-to-Counter conversion at scrape time; guarded because
         # ThreadingHTTPServer can run concurrent /metrics scrapes
@@ -498,6 +551,34 @@ class InferenceServer:
                 path = self.path.split("?", 1)[0]
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n)
+                if path == "/admin/drain":
+                    # guarded like /debug/*: draining is disruptive (a
+                    # replica stops admitting), so it shares the bearer
+                    # token; empty token = open, same contract
+                    if not self._authed():
+                        self.respond(401, "application/json",
+                                     json.dumps({"error": "unauthorized"}))
+                        return
+                    try:
+                        body = json.loads(raw or b"{}")
+                    except ValueError:
+                        body = {}
+                    if not isinstance(body, dict):
+                        body = {}
+                    try:
+                        resp = server.drain(
+                            resume=bool(body.get("resume", False)),
+                            timeout_s=float(body.get("timeout_s", 30.0)),
+                        )
+                    except ValueError as e:
+                        self.respond(400, "application/json", json.dumps(
+                            {"error": {"message": str(e),
+                                       "type": "invalid_request_error"}}
+                        ))
+                        return
+                    self.respond(200, "application/json",
+                                 json.dumps(resp))
+                    return
                 if path != "/v1/completions":
                     self.respond(404, "text/plain", "not found\n")
                     return
@@ -526,6 +607,17 @@ class InferenceServer:
                             {"error": {"message": str(e), "type": "invalid_request_error"}}
                         ))
                     except Exception as e:  # keep the serving thread alive
+                        if server._is_draining_error(e):
+                            # the request is valid; THIS replica just
+                            # won't take it — 503 with a typed body so
+                            # the router marks the replica draining and
+                            # routes elsewhere instead of relaying an
+                            # error to the client
+                            sp.set(status=503)
+                            self.respond(503, "application/json", json.dumps(
+                                {"error": {"message": str(e), "type": "draining"}}
+                            ))
+                            return
                         log.exception("completion failed")
                         sp.set(status=500)
                         self.respond(500, "application/json", json.dumps(
@@ -605,6 +697,9 @@ class InferenceServer:
         self.metrics["occupancy"].set(summary["batch_occupancy"])
         self.metrics["padding_waste"].set(summary["padding_waste_frac"])
         self.metrics["queue_depth"].set(summary["queue_depth"])
+        self.metrics["draining"].set(
+            1.0 if summary.get("draining") else 0.0
+        )
         sched = self.continuous.scheduler_stats()
         self.metrics["chunk_queue"].set(sched["chunk_queue"])
         self.metrics["parked"].set(sched["parked"])
@@ -632,10 +727,20 @@ class InferenceServer:
                 ("spec_draft_tokens", "spec_draft_tokens"),
                 ("spec_accepted_tokens", "spec_accepted_tokens"),
                 ("spec_rollbacks", "spec_rollbacks"),
+                ("migrated", "migrations"),
+                ("migration_chunks", "migration_chunks"),
             ):
                 delta = sched[key] - self._kv_last.get(key, 0)
                 self.metrics[name].inc(by=delta)
                 self._kv_last[key] = sched[key]
+            if self.kv_exports is not None:
+                # export-cache evictions ride the same delta-to-Counter
+                # conversion (the cache's int is monotonic per process)
+                ev = self.kv_exports.stats()["evictions"]
+                self.metrics["kv_export_evictions"].inc(
+                    by=ev - self._kv_last.get("export_evictions", 0)
+                )
+                self._kv_last["export_evictions"] = ev
             # ratio from the cumulative ints, not the deltas: a scrape
             # landing between windows would otherwise read 0/0 and
             # flap the gauge to zero
@@ -689,8 +794,11 @@ class InferenceServer:
             except ValueError:
                 self.metrics["requests"].inc(route_box["route"], "invalid")
                 raise
-            except Exception:
-                self.metrics["requests"].inc(route_box["route"], "error")
+            except Exception as e:
+                self.metrics["requests"].inc(
+                    route_box["route"],
+                    "draining" if self._is_draining_error(e) else "error",
+                )
                 raise
             finally:
                 span.set(route=route_box["route"])
@@ -788,6 +896,110 @@ class InferenceServer:
         else:
             self.metrics["disagg_fallbacks"].inc(reason or "unknown")
 
+    def _is_draining_error(self, e: BaseException) -> bool:
+        """Lazy-typed check: batching pulls jax, and this module must
+        stay importable in weightless tools — the class only exists to
+        be raised once a continuous engine does, so the import here
+        never runs before batching is loaded anyway."""
+        if self.continuous is None:
+            return False
+        from kubeinfer_tpu.inference.batching import EngineDrainingError
+
+        return isinstance(e, EngineDrainingError)
+
+    def _export_migration_chunk(self, chunk: dict) -> None:
+        """Engine migration sink (scheduler thread, OFF the engine
+        lock): wire-encode one streamed chunk and park it in the export
+        cache keyed by the chunk's own deepest fingerprint — exactly
+        where ``/kv/blocks`` serves from, so the target's chunked
+        importer (disagg.client.import_remote_chain) needs no new
+        endpoint. Chunk 0 encodes as plain v1/v2 (start_block=0); later
+        chunks ride wire v3. Raising here is fine: the engine treats a
+        sink failure as 'hand the session off with what already
+        streamed'."""
+        from kubeinfer_tpu.disagg.wire import encode_payload
+
+        blob = encode_payload(
+            chunk["pages_k"], chunk["pages_v"],
+            chunk["fingerprints"], chunk["block_size"],
+            scales_k=chunk.get("scales_k"),
+            scales_v=chunk.get("scales_v"),
+            kv_dtype=chunk.get("kv_dtype", "bf16"),
+            start_block=chunk["start_block"],
+        )
+        # export blocks/bytes are counted when /kv/blocks serves the
+        # blob (count-before-respond there); counting the put too would
+        # double-book the direction=export series
+        self.kv_exports.put(int(chunk["fingerprints"][-1]), blob)
+
+    def drain(self, resume: bool = False,
+              timeout_s: float = 30.0) -> dict:
+        """``POST /admin/drain``: stop admitting, migrate-or-complete
+        every live session, report. Three callers share this one
+        mechanism: scale-down (the reconciler drains before deleting
+        the pod), fault evacuation (SLO-burn-triggered), and hot-replica
+        rebalancing (``resume=True`` — hand the sessions off, then
+        rejoin the fleet). Blocks up to ``timeout_s``; a false
+        ``drained`` means sessions are still live (the caller retries
+        or escalates to a hard kill, which the fallback path absorbs
+        token-identically)."""
+        if self.continuous is None:
+            raise ValueError("drain requires the continuous batcher")
+        eng = self.continuous
+        before = eng.migrated_total
+        eng.drain()
+        drained = eng.wait_drained(timeout_s)
+        sched = eng.scheduler_stats()
+        out = {
+            "drained": bool(drained),
+            "draining": True,
+            "migrated": int(eng.migrated_total - before),
+            "migration_chunks_total": int(sched["migration_chunks"]),
+            "migration_blocks_total": int(sched["migration_blocks"]),
+            "exports": (
+                self.kv_exports.stats()
+                if self.kv_exports is not None else {}
+            ),
+        }
+        if resume and drained:
+            eng.undrain()
+            out["draining"] = False
+        return out
+
+    def _maybe_import_chain(self, tokens: list[int],
+                            base_url: str) -> None:
+        """Chunked warm-import of a migrated session's KV chain from
+        the SOURCE replica before the resume admit. Best-effort like
+        ``_maybe_import_prefix``, but failures count under the
+        migration fallback counter — a partial import is still a win
+        (the resume re-prefills only past the last verified chunk), so
+        blocks/bytes are recorded even when a reason is."""
+        from kubeinfer_tpu.disagg.client import import_remote_chain
+        from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+
+        eng = self.continuous
+        fps = prefix_fingerprints(tokens, eng.block_size)
+        if not fps:
+            return
+        advertised = set(
+            eng.cache_summary().get("fingerprints", [])
+        )
+        if fps[-1] in advertised:
+            return  # whole chain already warm (bounce-back resume)
+        t0 = time.perf_counter()
+        imported, reason, wire_bytes = import_remote_chain(
+            eng, tokens, base_url,
+            chunk_blocks=getattr(eng, "migration_chunk_blocks", 4),
+        )
+        if imported > 0:
+            self.metrics["kv_stream_blocks"].inc("import", by=imported)
+            self.metrics["kv_stream_bytes"].inc("import", by=wire_bytes)
+            self.metrics["kv_stream_seconds"].observe(
+                "import", time.perf_counter() - t0
+            )
+        if reason is not None:
+            self.metrics["migration_fallbacks"].inc(reason)
+
     def _complete(self, body: dict, route_box: dict) -> dict:
         prompt = body.get("prompt")
         if prompt is None:
@@ -812,6 +1024,24 @@ class InferenceServer:
         eos_id = -1
         if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
             eos_id = int(self.tokenizer.eos_token_id)
+
+        # live-session migration resume (router-injected): a source
+        # replica drained mid-generation and handed back its tokens-so-
+        # far (and optionally where to pull the streamed KV chain from)
+        resume = body.get("kubeinfer_resume")
+        resume_tokens: list[int] = []
+        if resume is not None:
+            if not isinstance(resume, dict):
+                raise ValueError("kubeinfer_resume must be an object")
+            rt = resume.get("tokens") or []
+            if not (
+                isinstance(rt, list)
+                and all(isinstance(t, int) for t in rt)
+            ):
+                raise ValueError(
+                    "kubeinfer_resume.tokens must be token ids (ints)"
+                )
+            resume_tokens = [int(t) for t in rt]
 
         # disaggregated decode side: the router annotates the forwarded
         # body with the prefill replica that just produced this prompt's
@@ -883,6 +1113,50 @@ class InferenceServer:
                         "blocks": len(exp["fingerprints"]),
                         "bytes": len(blob),
                     }}
+        elif resume_tokens:
+            # resume MUST ride the continuous batcher: only its
+            # position-folded key schedule reproduces the source's
+            # sampling stream mid-generation (park/readmit invariant);
+            # the sp/speculative/per-request engines would re-draw
+            if not (
+                self.continuous is not None
+                and self.continuous.fits(len(ids), max_tokens)
+            ):
+                raise ValueError(
+                    "kubeinfer_resume requires the continuous batcher "
+                    "and a prompt that fits its cache"
+                )
+            route_box["route"] = "resume"
+            if len(resume_tokens) >= max_tokens or (
+                eos_id >= 0 and resume_tokens[-1] == eos_id
+            ):
+                # degenerate tail: the source finished the generation
+                # before the hand-off completed — answer directly, no
+                # zero-budget admit
+                gen = resume_tokens[:max_tokens]
+            else:
+                src = resume.get("kv_source")
+                if isinstance(src, str) and src:
+                    # committed chain only — full blocks of the
+                    # effective prompt MINUS the last token (the
+                    # source's committed-blocks rule: the newest
+                    # token's KV never streamed)
+                    self._maybe_import_chain(
+                        (ids + resume_tokens)[:-1], src,
+                    )
+                req = self.continuous.serve(
+                    ids, max_new_tokens=max_tokens, eos_id=eos_id,
+                    temperature=temperature, seed=seed,
+                    top_k=top_k, top_p=top_p,
+                    repetition_penalty=rep_penalty,
+                    resume_tokens=resume_tokens,
+                )
+                gen = req.out_tokens
+                route_box["timing"] = req
+                if req.migrated is not None:
+                    # drained AGAIN mid-resume (rolling rebalance):
+                    # the router chains another hop off this ext
+                    route_box["ext"] = {"migrated": dict(req.migrated)}
         elif self.sp is not None and self.sp.fits(len(ids), max_tokens):
             # long prompts shard their prefill over the mesh's sp axis
             # (ring attention; sp_engine.py) and decode from the
@@ -952,6 +1226,12 @@ class InferenceServer:
             # hand the scheduler-stamped timeline to complete() for the
             # TTFT/TPOT/queue-wait histograms
             route_box["timing"] = req
+            if req.migrated is not None:
+                # the engine drained under this request: out_tokens is
+                # a PREFIX of the answer; the ext tells the router to
+                # re-route with these as the resume prefix (and pull
+                # the streamed chain from this replica's /kv/blocks)
+                route_box["ext"] = {"migrated": dict(req.migrated)}
         else:
             route_box["route"] = "engine"
             out = self.engine.generate(
@@ -966,6 +1246,12 @@ class InferenceServer:
         # test would mislabel that and invite clients to auto-continue a
         # finished sequence)
         stopped = eos_id >= 0 and bool(gen) and gen[-1] == eos_id
+        finish = "stop" if stopped else "length"
+        if (route_box.get("ext") or {}).get("migrated") is not None:
+            # partial generation by design — neither EOS nor budget;
+            # the router treats this as "continue elsewhere", a client
+            # seeing it raw knows the tokens are a prefix
+            finish = "migrated"
         return {
             "id": "cmpl-kubeinfer",
             "object": "text_completion",
@@ -974,7 +1260,7 @@ class InferenceServer:
                 "index": 0,
                 "text": self._decode(gen),
                 "tokens": gen,
-                "finish_reason": "stop" if stopped else "length",
+                "finish_reason": finish,
             }],
             "usage": {
                 "prompt_tokens": len(ids),
@@ -1046,6 +1332,16 @@ def main(argv: list[str] | None = None) -> int:
                         "blocks interleaved with decode steps, so a long "
                         "cold prompt never stalls the decode batch for "
                         "more than one chunk (0 = whole-suffix prefill)")
+    p.add_argument("--migration-chunk-blocks", type=int, default=4,
+                   help="KV blocks streamed per drain pass during live-"
+                        "session migration; decode windows run between "
+                        "chunks, so the stream chases the decode head "
+                        "instead of stalling it")
+    p.add_argument("--kv-export-budget-mb", type=float, default=0.0,
+                   help="byte budget for the KV export cache (prefill "
+                        "exports + migration chunks); 0 = entry cap "
+                        "only. Evictions past the budget count under "
+                        "kubeinfer_kv_export_evictions_total")
     p.add_argument("--kv-dtype", default="bf16",
                    choices=("bf16", "int8"),
                    help="paged KV pool dtype: int8 quantizes blocks on "
@@ -1215,6 +1511,7 @@ def main(argv: list[str] | None = None) -> int:
             ),
             spec_k=args.speculation_depth,
             kv_dtype=args.kv_dtype,
+            migration_chunk_blocks=args.migration_chunk_blocks,
         )
         if args.prewarm_spec and speculative is not None:
             sizes = tuple(
@@ -1242,6 +1539,7 @@ def main(argv: list[str] | None = None) -> int:
         speculative=speculative, sp=sp_engine,
         tls_cert=args.tls_cert_file, tls_key=args.tls_key_file,
         token=debug_token, slo=slo,
+        kv_export_budget_mb=args.kv_export_budget_mb,
     ).start()
     log.info("native inference server on %s:%d (model %s)",
              args.host, srv.port, args.model)

@@ -19,6 +19,7 @@ from kubeinfer_tpu.metrics.registry import (
     breaker_state,
     breaker_transitions_total,
     coordinator_elections_total,
+    evacuations_total,
     fault_injections_total,
     llmservice_ready_replicas,
     llmservice_total,
@@ -44,6 +45,7 @@ __all__ = [
     "breaker_state",
     "breaker_transitions_total",
     "coordinator_elections_total",
+    "evacuations_total",
     "fault_injections_total",
     "llmservice_ready_replicas",
     "llmservice_total",

@@ -227,6 +227,13 @@ reconcile_total = Counter(
     "Reconcile outcomes",  # metrics.go:120-126
     labels=("controller", "result"),
 )
+evacuations_total = Counter(
+    "kubeinfer_evacuations_total",
+    "SLO-burn evacuations triggered by the reconciler, by node and "
+    "outcome (drained = the drainer confirmed; failed = it raised or "
+    "declined — the node stays a candidate next tick)",
+    labels=("node", "outcome"),
+)
 reconcile_duration_seconds = Histogram(
     "kubeinfer_reconcile_duration_seconds",
     "Reconcile duration",  # metrics.go:140-146 (DefBuckets)

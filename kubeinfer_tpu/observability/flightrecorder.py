@@ -33,6 +33,10 @@ KINDS = (
     # disaggregated prefill/decode (disagg/): a remote prefix staged
     # for scatter, landed in the pool, or rejected at validation
     "import_staged", "import", "import_reject",
+    # live-session migration (drain): the drain window, one streamed
+    # chunk, a session handed off, and the sink-failure fall-forward
+    "drain_start", "drain_end", "migrate_chunk", "migrate",
+    "migrate_sink_error",
 )
 
 

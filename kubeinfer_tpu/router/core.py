@@ -78,7 +78,9 @@ def _router_metrics(registry: Registry) -> dict:
             "kubeinfer_router_replicas_skipped_total",
             "Replicas excluded from a decision's candidate set "
             "(breaker = circuit open; dead = signal older than the TTL; "
-            "failed = transport failure earlier in this same request)",
+            "failed = transport failure earlier in this same request; "
+            "draining = replica advertised drain, migrating its "
+            "sessions out)",
             labels=("replica", "reason"), registry=registry,
         ),
         "replicas": Gauge(
@@ -104,6 +106,23 @@ def _router_metrics(registry: Registry) -> dict:
             "kubeinfer_disagg_fallbacks_total",
             "Two-phase requests that degraded to single-phase routing "
             "(interleaved local prefill), by reason",
+            labels=("reason",), registry=registry,
+        ),
+        # live-session migration (drain/evacuate/rebalance): a source
+        # replica parks a mid-flight generation and the router resumes
+        # it elsewhere with the tokens-so-far (kubeinfer_resume)
+        "migration_resumes": Counter(
+            "kubeinfer_router_migration_resumes_total",
+            "Migrated sessions resumed on a new replica, by target",
+            labels=("replica",), registry=registry,
+        ),
+        # shares the inference server's metric name for the same
+        # one-family dashboard reason as disagg_fallbacks above
+        "migration_fallbacks": Counter(
+            "kubeinfer_migration_fallbacks_total",
+            "Migration hand-offs that degraded at the router, by reason "
+            "(no_target = every other replica dead/draining; hop_limit "
+            "= rolling drains exceeded the per-request resume budget)",
             labels=("reason",), registry=registry,
         ),
     }
@@ -259,6 +278,19 @@ class FleetRouter:
                 s.metadata.name, getattr(s, "serving_stats", None), age_s=age,
             )
 
+    def mark_draining(self, name: str) -> None:
+        """Locally mark a replica as draining ahead of its next poll.
+        The proxy calls this on a 503 drain verdict so the re-route
+        inside the SAME request already skips the replica — waiting
+        for the poller would bounce every in-between request off the
+        same 503. The next authoritative refresh replaces the serving
+        dict wholesale, so an undrain clears this without ceremony."""
+        with self._lock:
+            view = (self._replicas.get(name)
+                    or self._prefill_replicas.get(name))
+            if view is not None:
+                view.serving = dict(view.serving, draining=True)
+
     def note_routed(self, decision: RouteDecision,
                     tokens: Sequence[int]) -> None:
         """Optimistic insert after a successfully proxied request: the
@@ -301,6 +333,9 @@ class FleetRouter:
             if view.breaker is not None and not view.breaker.peek():
                 self.metrics["skipped"].inc(view.name, "breaker")
                 continue
+            if view.serving.get("draining"):
+                self.metrics["skipped"].inc(view.name, "draining")
+                continue
             key = (scoring.queue_pressure(view.serving), view.name)
             if best_key is None or key < best_key:
                 best_key = key
@@ -341,7 +376,7 @@ class FleetRouter:
                       exclude: frozenset | set) -> RouteDecision:
         now = self._clock()
         fps_by_bs: dict[int, list[int]] = {}
-        counts = {"alive": 0, "stale": 0, "dead": 0}
+        counts = {"alive": 0, "stale": 0, "dead": 0, "draining": 0}
         best: tuple[float, str] | None = None
         best_info: RouteDecision | None = None
         n_scored = 0
@@ -361,6 +396,15 @@ class FleetRouter:
             # choose — the proxy's RetryPolicy is the one consumer
             if view.breaker is not None and not view.breaker.peek():
                 self.metrics["skipped"].inc(view.name, "breaker")
+                continue
+            # draining replicas finish what they hold (the proxy keeps
+            # relaying in-flight responses) but take no NEW placements;
+            # a drain with zero healthy peers is the operator's call to
+            # make, so NoReplicaError — not a silent placement onto the
+            # very replica being emptied
+            if view.serving.get("draining"):
+                counts["draining"] += 1
+                self.metrics["skipped"].inc(view.name, "draining")
                 continue
             stale = age > self.stale_after_s
             counts["stale" if stale else "alive"] += 1

@@ -64,6 +64,15 @@ _PROXY_POLICY = RetryPolicy(
     deadline_s=10.0, classify=connect_failure,
 )
 
+# Resume-hop budget per request: each hop means the replica that was
+# SERVING the generation started draining mid-flight, so >1 only
+# happens during rolling rebalances. The cap exists because a fleet
+# where every replica is perpetually draining would otherwise bounce a
+# session forever; at the limit the last partial response is relayed
+# (finish_reason="migrated", tokens-so-far intact — the client resumes
+# or resubmits, nothing is lost).
+_MAX_MIGRATION_HOPS = 3
+
 
 class RouterServer:
     """Fleet front door over a FleetRouter."""
@@ -180,21 +189,48 @@ class RouterServer:
                 body["kubeinfer_kv_source"] = kv_source
                 raw_body = json.dumps(body).encode()
         tried: set[str] = set()
+        hops = 0
+        parked: tuple[bytes, object] | None = None
         while True:
             try:
                 decision = self.router.route(tokens, exclude=tried)
             except NoReplicaError as e:
+                if parked is not None:
+                    # the resume has nowhere to go (every peer dead,
+                    # draining, or failed): relay the source's partial
+                    # verbatim — finish_reason="migrated" with the
+                    # tokens-so-far intact, so the client holds
+                    # everything generated and nothing is lost
+                    self.router.metrics["migration_fallbacks"].inc(
+                        "no_target"
+                    )
+                    return 200, self._annotate(
+                        parked[0], parked[1], hops
+                    )
                 return 502, json.dumps({"error": {
                     "message": str(e), "type": "no_replica"}}).encode()
             try:
                 payload = self._proxy(decision, raw_body)
             except urllib.error.HTTPError as e:
+                err_body = e.read()
+                # a drain verdict is the one 5xx that is guaranteed
+                # replica-specific: the engine refused ADMISSION, it
+                # did not fail the request — mark the view (the next
+                # poll would, but every request in between would bounce
+                # off the same 503) and re-score elsewhere
+                if e.code == 503 and self._is_drain_verdict(err_body):
+                    self.router.mark_draining(decision.replica)
+                    self.router.metrics["requests"].inc(
+                        decision.replica, "draining"
+                    )
+                    tried.add(decision.replica)
+                    continue
                 # the replica ANSWERED (4xx/5xx): relay its verdict —
                 # a validation error would fail identically anywhere
                 self.router.metrics["requests"].inc(
                     decision.replica, f"http_{e.code}"
                 )
-                return e.code, e.read()
+                return e.code, err_body
             except Exception as e:  # noqa: BLE001 — transport failure
                 log.warning("replica %s unreachable (%s); re-scoring",
                             decision.replica, type(e).__name__)
@@ -206,7 +242,78 @@ class RouterServer:
             self.router.metrics["requests"].inc(decision.replica, "ok")
             if tokens:
                 self.router.note_routed(decision, tokens)
-            return 200, self._annotate(payload, decision)
+            if hops:
+                self.router.metrics["migration_resumes"].inc(
+                    decision.replica
+                )
+            # live-session migration: the replica drained mid-flight
+            # and handed back its generation-so-far instead of
+            # finishing — resume on another replica with the body
+            # annotated so the target can stream the source's KV chain
+            # (or re-prefill token-identically when it can't)
+            migrated = self._migrated_ext(payload)
+            if migrated is not None:
+                if hops >= _MAX_MIGRATION_HOPS:
+                    self.router.metrics["migration_fallbacks"].inc(
+                        "hop_limit"
+                    )
+                    return 200, self._annotate(payload, decision, hops)
+                hops += 1
+                parked = (payload, decision)
+                raw_body = self._resume_body(body, migrated, decision.url)
+                # only the source is excluded: earlier transport
+                # failures get a fresh chance — the resume is a NEW
+                # placement and the breaker still gates dead peers
+                tried = {decision.replica}
+                continue
+            return 200, self._annotate(payload, decision, hops)
+
+    @staticmethod
+    def _is_drain_verdict(err_body: bytes) -> bool:
+        """Is this error body the inference server's 503
+        {"error": {"type": "draining"}} admission refusal? Anything
+        else 503-shaped (a proxy in between, an OOM handler) relays
+        like a normal upstream verdict."""
+        try:
+            doc = json.loads(err_body or b"{}")
+        except ValueError:
+            return False
+        err = doc.get("error") if isinstance(doc, dict) else None
+        return isinstance(err, dict) and err.get("type") == "draining"
+
+    @staticmethod
+    def _migrated_ext(payload: bytes) -> dict | None:
+        """Extract the ``kubeinfer.migrated`` hand-off from a replica
+        response, or None for a normally finished generation. The
+        hand-off carries the tokens generated so far and how many KV
+        blocks the source streamed into its export cache."""
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict):
+            return None
+        migrated = (doc.get("kubeinfer") or {}).get("migrated")
+        return migrated if isinstance(migrated, dict) else None
+
+    @staticmethod
+    def _resume_body(body: dict, migrated: dict,
+                     source_url: str) -> bytes:
+        """Build the resume-hop request: same prompt and sampling
+        params (token identity needs the original seed), annotated
+        with the source's generation-so-far. ``kv_source`` is only
+        attached when the source actually streamed chunks — with zero
+        blocks exported a chain fetch could only burn the target's
+        TTFT before the same re-prefill; the prefill-phase annotation
+        (strictly shallower than the migration chain) is dropped for
+        the same reason whenever the chain is present."""
+        resume: dict = {"tokens": list(migrated.get("tokens") or [])}
+        out = dict(body)
+        if migrated.get("blocks"):
+            resume["kv_source"] = source_url
+            out.pop("kubeinfer_kv_source", None)
+        out["kubeinfer_resume"] = resume
+        return json.dumps(out).encode()
 
     def _prefill_phase(self, tokens: list[int],
                        body: dict) -> str | None:
@@ -303,10 +410,11 @@ class RouterServer:
         )
 
     @staticmethod
-    def _annotate(payload: bytes, decision) -> bytes:
+    def _annotate(payload: bytes, decision, hops: int = 0) -> bytes:
         """Stamp the routing decision into the response's ``kubeinfer``
         extension block so clients (and the chaos test) can see which
-        replica served and whether affinity hit."""
+        replica served, whether affinity hit, and how many migration
+        hops the session survived on the way."""
         try:
             doc = json.loads(payload)
         except ValueError:
@@ -317,6 +425,8 @@ class RouterServer:
         ext["replica"] = decision.replica
         ext["match_blocks"] = decision.match_blocks
         ext["fallback"] = decision.fallback
+        if hops:
+            ext["resume_hops"] = hops
         return json.dumps(doc).encode()
 
     # -- replica-state refresh ----------------------------------------------

@@ -1,0 +1,915 @@
+"""Live-session KV migration: drain, evacuate, rebalance — zero token
+loss.
+
+Layering mirrors test_disagg.py: wire v3 (chunk offsets) is pure numpy,
+export-budget tests are pure LRU bookkeeping, chain-client tests drive
+``import_remote_chain`` against synthetic chunk stores, and the engine
+tests run the REAL drain protocol — a live request parked mid-decode,
+its committed chain streamed through the migration sink, and the resume
+proven token-identical to an uninterrupted run (greedy AND sampled, bf16
+AND int8; the position-folded key schedule is what makes the sampled
+case exact). Server and router tests stand up real fleets for the
+/admin/drain -> migrated -> resume hop, including the no-target and
+dead-target degradations where the partial generation must survive
+verbatim (the zero-token-loss contract is about tokens, not blocks).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import kubeinfer_tpu.disagg.client as client_mod
+from kubeinfer_tpu.disagg.client import (
+    KVFetchError,
+    fetch_kv_blocks,
+    import_remote_chain,
+)
+from kubeinfer_tpu.disagg.export import KVExportCache
+from kubeinfer_tpu.disagg.wire import (
+    WireError,
+    decode_payload,
+    encode_payload,
+)
+from kubeinfer_tpu.inference import PRESETS, init_params
+from kubeinfer_tpu.inference.batching import (
+    ContinuousEngine,
+    EngineDrainingError,
+)
+from kubeinfer_tpu.inference.engine import Engine
+from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+from kubeinfer_tpu.inference.server import InferenceServer
+from kubeinfer_tpu.router import FleetRouter, RouterServer
+from kubeinfer_tpu.utils.clock import SimulatedClock
+
+TINY = PRESETS["tiny"]
+BS = 16  # block size shared by every engine here
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY, jax.random.PRNGKey(0))
+
+
+def mk_engine(params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("cache_len", 128)
+    kw.setdefault("block_size", BS)
+    return ContinuousEngine(params, TINY, **kw).start()
+
+
+def prompt_tokens(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, TINY.vocab_size, size=n).tolist()
+
+
+def _wait_for(cond, timeout=30.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _blob_sink(blobs: dict):
+    """A migration sink that wire-encodes each streamed chunk and keys
+    it by the chunk's own deepest fingerprint — the same addressing the
+    server's export cache uses, so ``import_remote_chain`` (with the
+    fetch monkeypatched onto the dict) sees exactly the wire a real
+    target would."""
+
+    def sink(chunk):
+        blob = encode_payload(
+            chunk["pages_k"], chunk["pages_v"],
+            chunk["fingerprints"], chunk["block_size"],
+            scales_k=chunk.get("scales_k"),
+            scales_v=chunk.get("scales_v"),
+            kv_dtype=chunk.get("kv_dtype", "bf16"),
+            start_block=chunk["start_block"],
+        )
+        blobs[int(chunk["fingerprints"][-1])] = blob
+
+    return sink
+
+
+def _pages(blocks=3, layers=2, n_kv=2, d=8, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (layers, blocks, 4, n_kv, d)
+    k = rng.standard_normal(shape).astype(dtype)
+    v = rng.standard_normal(shape).astype(dtype)
+    return k, v
+
+
+class TestWireV3:
+    def test_chunk_round_trip_carries_offset(self):
+        k, v = _pages()
+        blob = encode_payload(k, v, [7, 8, 9], block_size=4,
+                              start_block=5)
+        assert blob.split(b"\n", 1)[0].startswith(
+            b'{"magic": "kubeinfer-kvwire/3"'
+        )
+        p = decode_payload(blob)
+        assert p.start_block == 5
+        assert p.kv_dtype == "bf16" and p.scales_k is None
+        assert np.array_equal(p.pages_k, k)
+        assert p.fingerprints == (7, 8, 9)
+
+    def test_chunk_zero_is_byte_identical_to_v1(self):
+        """Chunk 0 must not grow a new wire spelling: a zero offset
+        encodes as plain v1, so pre-v3 importers (and the v1
+        byte-identity pin in test_disagg) never see the new magic."""
+        k, v = _pages()
+        assert encode_payload(k, v, [1, 2, 3], block_size=4,
+                              start_block=0) == \
+            encode_payload(k, v, [1, 2, 3], block_size=4)
+
+    def test_int8_chunk_rides_v3_with_scales(self):
+        k, v = _pages(dtype=np.int8)
+        sk = np.ones((2, 3, 2), np.float32)
+        sv = np.ones((2, 3, 2), np.float32) * 2
+        blob = encode_payload(k, v, [4, 5, 6], block_size=4,
+                              scales_k=sk, scales_v=sv,
+                              kv_dtype="int8", start_block=2)
+        p = decode_payload(blob)
+        assert p.start_block == 2 and p.kv_dtype == "int8"
+        assert np.array_equal(p.scales_v, sv)
+
+    def test_negative_offset_rejected_at_encode(self):
+        k, v = _pages()
+        with pytest.raises(WireError, match="start_block"):
+            encode_payload(k, v, [1, 2, 3], block_size=4,
+                           start_block=-1)
+
+    def test_forged_zero_offset_v3_header_rejected(self):
+        """A v3 header claiming start_block=0 would be a second byte
+        spelling of the same v1 payload, splitting the content address
+        — decode must refuse it even though the checksum holds."""
+        k, v = _pages()
+        blob = encode_payload(k, v, [1, 2, 3], block_size=4)
+        head, body = blob.split(b"\n", 1)
+        doc = json.loads(head)
+        doc["magic"] = "kubeinfer-kvwire/3"
+        doc["kv_dtype"] = "bf16"
+        doc["start_block"] = 0
+        forged = json.dumps(doc).encode() + b"\n" + body
+        with pytest.raises(WireError, match="start_block"):
+            decode_payload(forged)
+
+
+class TestExportBudget:
+    def test_bytes_budget_evicts_oldest(self):
+        c = KVExportCache(capacity=10, max_bytes=100)
+        c.put(1, b"a" * 60)
+        c.put(2, b"b" * 60)  # 120 > 100: fp 1 must go
+        assert c.get(1) is None
+        assert c.get(2) == b"b" * 60
+        s = c.stats()
+        assert s["bytes"] == 60 and s["max_bytes"] == 100
+        assert s["evictions"] == 1
+
+    def test_oversized_single_blob_stays_servable(self):
+        """A blob larger than the whole budget must survive its own
+        put — otherwise a big migration chunk could never leave the
+        source replica."""
+        c = KVExportCache(capacity=10, max_bytes=100)
+        c.put(1, b"x" * 150)
+        assert c.get(1) == b"x" * 150
+        # the next put pushes the oversized one out (LRU order)
+        c.put(2, b"y" * 40)
+        assert c.get(1) is None and c.get(2) is not None
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError, match="max_bytes"):
+            KVExportCache(max_bytes=0)
+        assert KVExportCache(max_bytes=None).stats()["max_bytes"] is None
+
+
+class _ChainTarget:
+    """Engine stand-in for pure chain-client tests: records each
+    landed chunk and accepts everything (the real scatter is covered by
+    the engine tests below)."""
+
+    block_size = 4
+    kv_dtype = "bf16"
+
+    def __init__(self):
+        self.calls = []
+
+    def import_prefix(self, tokens, pages_k, pages_v, timeout_s=10.0,
+                      scales_k=None, scales_v=None, kv_dtype="bf16",
+                      start_block=0):
+        self.calls.append((len(tokens), int(pages_k.shape[1]),
+                           start_block))
+        return int(pages_k.shape[1]), None
+
+
+def _chunk_store(tokens, bs=4, chunk_blocks=2):
+    """Wire-encoded chunk blobs for ``tokens``, keyed like the export
+    cache: each chunk by its own deepest fingerprint."""
+    fps = prefix_fingerprints(tokens, bs)
+    layers, n_kv, d = 2, 2, 8
+    rng = np.random.default_rng(3)
+    blobs = {}
+    for start in range(0, len(fps), chunk_blocks):
+        end = min(start + chunk_blocks, len(fps))
+        shape = (layers, end - start, bs, n_kv, d)
+        k = rng.standard_normal(shape).astype(np.float32)
+        v = rng.standard_normal(shape).astype(np.float32)
+        blobs[fps[end - 1]] = encode_payload(
+            k, v, fps[start:end], block_size=bs, start_block=start,
+        )
+    return fps, blobs
+
+
+class TestChainClient:
+    def test_full_chain_imports_chunk_by_chunk(self, monkeypatch):
+        toks = prompt_tokens(24, seed=41)
+        fps, blobs = _chunk_store(toks)
+        monkeypatch.setattr(
+            client_mod, "fetch_kv_blocks",
+            lambda base, fp, timeout_s=0, rng=None:
+                decode_payload(blobs[int(fp)]),
+        )
+        eng = _ChainTarget()
+        n, reason, nbytes = import_remote_chain(
+            eng, toks, "http://unused", chunk_blocks=2,
+        )
+        assert (n, reason) == (6, None)
+        # wire accounting is payload bytes (pages + scales), per chunk
+        assert nbytes == sum(
+            decode_payload(b).byte_size for b in blobs.values()
+        )
+        # chunks landed incrementally at their own offsets
+        assert [c[2] for c in eng.calls] == [0, 2, 4]
+        assert [c[0] for c in eng.calls] == [8, 16, 24]
+
+    def test_wrong_offset_chunk_is_fingerprint_mismatch(self,
+                                                        monkeypatch):
+        """A blob served at the wrong chain position (LRU collision,
+        stale export) must stop the import at the last verified chunk,
+        never scatter: the fingerprint slice encodes the offset."""
+        toks = prompt_tokens(24, seed=42)
+        fps, blobs = _chunk_store(toks)
+        # serve chunk [2,4) when chunk [0,2) is asked for
+        blobs[fps[1]] = blobs[fps[3]]
+        monkeypatch.setattr(
+            client_mod, "fetch_kv_blocks",
+            lambda base, fp, timeout_s=0, rng=None:
+                decode_payload(blobs[int(fp)]),
+        )
+        eng = _ChainTarget()
+        n, reason, _ = import_remote_chain(
+            eng, toks, "http://unused", chunk_blocks=2,
+        )
+        assert (n, reason) == (0, "fingerprint_mismatch")
+        assert eng.calls == []
+
+    def test_mid_chain_fetch_failure_keeps_partial(self, monkeypatch):
+        toks = prompt_tokens(24, seed=43)
+        fps, blobs = _chunk_store(toks)
+        calls = {"n": 0}
+
+        def fetch(base, fp, timeout_s=0, rng=None):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise KVFetchError("boom")
+            return decode_payload(blobs[int(fp)])
+
+        monkeypatch.setattr(client_mod, "fetch_kv_blocks", fetch)
+        eng = _ChainTarget()
+        n, reason, nbytes = import_remote_chain(
+            eng, toks, "http://unused", chunk_blocks=2,
+        )
+        # chunk 0 landed — the resume re-prefills from block 2, not 0
+        assert (n, reason) == (2, "fetch_error")
+        assert nbytes == decode_payload(blobs[fps[1]]).byte_size
+
+    def test_chain_deadline_is_timeout_reason(self, monkeypatch):
+        toks = prompt_tokens(24, seed=44)
+        fps, blobs = _chunk_store(toks)
+
+        def slow_fetch(base, fp, timeout_s=0, rng=None):
+            time.sleep(0.06)
+            return decode_payload(blobs[int(fp)])
+
+        monkeypatch.setattr(client_mod, "fetch_kv_blocks", slow_fetch)
+        eng = _ChainTarget()
+        n, reason, _ = import_remote_chain(
+            eng, toks, "http://unused", chunk_blocks=2,
+            deadline_s=0.03,
+        )
+        assert reason == "timeout"
+        assert n == 2  # the first chunk beat the deadline check
+
+    def test_stalling_peer_surfaces_as_timeout(self):
+        """A peer that ACCEPTS the connection and then never answers
+        must cost one per-attempt socket timeout, not the whole
+        deadline: the fetch classifies as timed_out and the chain
+        import counts the 'timeout' fallback reason."""
+        with _stalling_server() as port:
+            with pytest.raises(KVFetchError) as ei:
+                fetch_kv_blocks(
+                    f"http://127.0.0.1:{port}", 1, timeout_s=0.2,
+                )
+            assert ei.value.timed_out
+            eng = _ChainTarget()
+            n, reason, _ = import_remote_chain(
+                eng, prompt_tokens(8), f"http://127.0.0.1:{port}",
+                attempt_timeout_s=0.2,
+            )
+            assert (n, reason) == (0, "timeout")
+
+
+@contextlib.contextmanager
+def _stalling_server():
+    """Accepts TCP connections and never responds — the stalled-socket
+    failure mode a half-dead replica presents."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    srv.settimeout(0.1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+    held = []
+
+    def run():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            held.append(conn)  # hold open; read nothing, answer nothing
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        yield port
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        for c in held:
+            c.close()
+        srv.close()
+
+
+# (kv_dtype, sampling) cases: the sampled case is the one only the
+# position-folded key schedule can keep exact across the hop; int8
+# proves the committed-quantized chunks (scales on the wire) land
+# bit-identically in the target's quantized pool.
+MIGRATION_CASES = [
+    pytest.param("bf16", {}, id="bf16-greedy"),
+    pytest.param(
+        "bf16", {"temperature": 0.8, "top_p": 0.9, "seed": 7},
+        id="bf16-sampled",
+    ),
+    pytest.param("int8", {}, id="int8-greedy"),
+]
+
+
+def _drain_live_session(eng, prompt, max_new, sampling,
+                        min_tokens=3):
+    """Submit, let decode get ahead, then drain: returns the completed
+    request, which must have migrated (eos is disabled and the budget
+    is far beyond min_tokens, so the drain always wins the race)."""
+    req = eng.submit(prompt, max_new_tokens=max_new, eos_id=-1,
+                     **sampling)
+    assert _wait_for(lambda: len(req.out_tokens) >= min_tokens)
+    eng.drain()
+    assert eng.wait_drained(30.0)
+    assert req.done.wait(5.0)
+    assert req.migrated is not None
+    return req
+
+
+class TestEngineDrain:
+    def test_drain_idle_refuses_and_undrain_recovers(self, params):
+        eng = mk_engine(params)
+        try:
+            assert not eng.draining
+            eng.drain()
+            eng.drain()  # idempotent
+            assert eng.draining
+            assert eng.wait_drained(10.0)
+            with pytest.raises(EngineDrainingError):
+                eng.submit(prompt_tokens(8))
+            eng.undrain()
+            assert not eng.draining
+            assert eng.generate(prompt_tokens(8), max_new_tokens=2,
+                                eos_id=-1)
+        finally:
+            eng.stop()
+
+    def test_resume_tokens_validation(self, params):
+        # cache_len off the bucket grid: the resume's effective prompt
+        # (40 + 30) pads to the 128 bucket even though the raw token
+        # count fits — exactly the silent-empty-completion case the
+        # admit-time check must refuse
+        eng = mk_engine(params, cache_len=96)
+        try:
+            p = prompt_tokens(40)
+            with pytest.raises(ValueError, match="budget"):
+                eng.submit(p, max_new_tokens=4,
+                           resume_tokens=[1, 2, 3, 4])
+            with pytest.raises(ValueError, match="resume bucket"):
+                eng.submit(p, max_new_tokens=56,
+                           resume_tokens=list(range(30)))
+        finally:
+            eng.stop()
+
+    @pytest.mark.parametrize("kv_dtype,sampling", MIGRATION_CASES)
+    def test_migrated_session_resumes_token_identical(
+            self, params, monkeypatch, kv_dtype, sampling):
+        """The tentpole invariant, end to end at the engine layer:
+        source drains mid-decode, streams its committed chain chunk by
+        chunk, and the target — warm-importing that chain — finishes
+        the generation with EXACTLY the tokens an uninterrupted run
+        produces. chunk_blocks=1 keeps source and importer chunk
+        boundaries aligned independent of drain/decode interleaving."""
+        p = prompt_tokens(40, seed=51)
+        n_new = 64
+        ref = mk_engine(params, kv_dtype=kv_dtype)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1,
+                              **sampling)
+        ref.stop()
+        assert len(expect) == n_new  # eos disabled: full budget
+
+        blobs: dict = {}
+        a = mk_engine(params, kv_dtype=kv_dtype,
+                      migration_chunk_blocks=1)
+        try:
+            a.migration_sink = _blob_sink(blobs)
+            req = _drain_live_session(a, p, n_new, sampling)
+            mig = req.migrated
+            toks = mig["tokens"]
+            assert toks == req.out_tokens
+            assert 3 <= len(toks) < n_new
+            # zero token loss at the source: the hand-off is a prefix
+            # of the uninterrupted answer
+            assert toks == expect[:len(toks)]
+            chain = (p + toks)[:-1]
+            committed = len(prefix_fingerprints(chain, BS))
+            assert mig["blocks"] == committed
+            assert mig["block_size"] == BS
+            assert mig["kv_dtype"] == kv_dtype
+            assert a.migrated_total == 1
+            assert a.migration_chunks_total == committed
+            assert a.migration_blocks_total == committed
+            # every chunk reached the sink, addressable by fingerprint
+            fps = prefix_fingerprints(chain, BS)
+            assert set(blobs) == set(fps)
+        finally:
+            a.stop()
+
+        monkeypatch.setattr(
+            client_mod, "fetch_kv_blocks",
+            lambda base, fp, timeout_s=0, rng=None:
+                decode_payload(blobs[int(fp)]),
+        )
+        b = mk_engine(params, kv_dtype=kv_dtype,
+                      migration_chunk_blocks=1)
+        try:
+            n, reason, nbytes = import_remote_chain(
+                b, chain, "http://unused", chunk_blocks=1,
+            )
+            assert (n, reason) == (committed, None)
+            assert nbytes > 0
+            out = b.serve(p, max_new_tokens=n_new, eos_id=-1,
+                          resume_tokens=toks, **sampling).out_tokens
+            # the resume returns the FULL answer (resume prefix
+            # included), token-identical to the uninterrupted run
+            assert out == expect
+        finally:
+            b.stop()
+
+    def test_bounce_back_resume_lands_warm_locally(self, params):
+        """Rebalance cancelled / target died: the session returns to
+        the SOURCE after undrain. ``_migrate_slot`` parked the
+        committed blocks in the trie, so the resume admit radix-matches
+        them — no import, no re-prefill of the streamed prefix — and
+        the tokens still match the uninterrupted run."""
+        p = prompt_tokens(40, seed=52)
+        n_new = 64
+        ref = mk_engine(params)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1)
+        ref.stop()
+        a = mk_engine(params, migration_chunk_blocks=1)
+        try:
+            a.migration_sink = _blob_sink({})
+            req = _drain_live_session(a, p, n_new, {})
+            toks = req.migrated["tokens"]
+            hits_before = a.kv_cache_stats()["hits"]
+            a.undrain()
+            out = a.serve(p, max_new_tokens=n_new, eos_id=-1,
+                          resume_tokens=toks).out_tokens
+            assert out == expect
+            assert a.imports_total == 0
+            assert a.kv_cache_stats()["hits"] > hits_before
+        finally:
+            a.stop()
+
+    def test_no_sink_drain_degrades_to_reprefill_resume(self, params):
+        """A replica with no sink wired (or a dead export path) still
+        drains: nothing streams, migrated['blocks'] == 0, and the
+        target resumes by plain re-prefill — token-identical, just
+        cold."""
+        p = prompt_tokens(40, seed=53)
+        n_new = 64
+        ref = mk_engine(params)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1)
+        ref.stop()
+        a = mk_engine(params)  # migration_sink stays None
+        try:
+            req = _drain_live_session(a, p, n_new, {})
+            toks = req.migrated["tokens"]
+            assert req.migrated["blocks"] == 0
+            assert a.migration_chunks_total == 0
+        finally:
+            a.stop()
+        b = mk_engine(params)
+        try:
+            out = b.serve(p, max_new_tokens=n_new, eos_id=-1,
+                          resume_tokens=toks).out_tokens
+            assert out == expect
+            assert b.imports_total == 0
+        finally:
+            b.stop()
+
+    def test_broken_sink_falls_forward_not_wedged(self, params):
+        """A raising sink must not wedge the drain: the session hands
+        off immediately with whatever already streamed (here: nothing)
+        and the drain completes."""
+        p = prompt_tokens(40, seed=54)
+        a = mk_engine(params, migration_chunk_blocks=1)
+        try:
+            def sink(chunk):
+                raise RuntimeError("sink down")
+
+            a.migration_sink = sink
+            req = _drain_live_session(a, p, 64, {})
+            assert req.migrated["blocks"] == 0
+            assert a.migration_chunks_total == 0
+        finally:
+            a.stop()
+
+    def test_chunk_on_missing_prefix_is_rejected(self, params):
+        """A v3 chunk can only stack on the exact prefix it continues:
+        landing chunk i on an engine that never saw chunks [0, i) must
+        fail with missing_prefix, never cache a chain with a hole."""
+        p = prompt_tokens(40, seed=55)
+        a = mk_engine(params)
+        exp = a.serve(p, max_new_tokens=0, eos_id=-1,
+                      export_kv=True).kv_export
+        a.stop()
+        b = mk_engine(params)
+        try:
+            n, reason = b.import_prefix(
+                p[:2 * BS],
+                exp["pages_k"][:, 1:2], exp["pages_v"][:, 1:2],
+                start_block=1,
+            )
+            assert (n, reason) == (0, "missing_prefix")
+        finally:
+            b.stop()
+
+
+@pytest.mark.slow
+class TestServerDrain:
+    @pytest.fixture(scope="class")
+    def pair(self, params):
+        servers = []
+        for name in ("src", "dst"):
+            cont = mk_engine(params, migration_chunk_blocks=1)
+            srv = InferenceServer(
+                Engine(params, TINY), model_id=name, port=0,
+                continuous=cont,
+            ).start()
+            servers.append((srv, cont))
+        yield servers
+        for srv, cont in servers:
+            srv.stop()
+            cont.stop()
+
+    def _post(self, port, body, path="/v1/completions"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+
+    def test_drain_migrate_resume_roundtrip(self, pair, params):
+        (src, src_cont), (dst, dst_cont) = pair
+        p = prompt_tokens(40, seed=61)
+        n_new = 48
+        ref = mk_engine(params)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1)
+        ref.stop()
+
+        result = {}
+
+        def client():
+            result["status"], result["doc"] = self._post(
+                src.port, {"prompt": p, "max_tokens": n_new},
+            )
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert _wait_for(lambda: any(
+            r is not None and len(r.out_tokens) >= 2
+            for r in src_cont._slot_req
+        ))
+        status, report = self._post(src.port, {}, path="/admin/drain")
+        assert status == 200
+        assert report["drained"] and report["draining"]
+        assert report["migrated"] == 1
+        assert report["migration_chunks_total"] >= 1
+        assert report["exports"]["entries"] >= 1
+        t.join(60)
+        assert result["status"] == 200
+        doc = result["doc"]
+        assert doc["choices"][0]["finish_reason"] == "migrated"
+        mig = doc["kubeinfer"]["migrated"]
+        toks = mig["tokens"]
+        assert doc["choices"][0]["tokens"] == toks == \
+            expect[:len(toks)]
+        assert mig["blocks"] >= 1
+
+        # a draining replica 503s new work with the typed verdict the
+        # router keys on
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(src.port, {"prompt": p, "max_tokens": 2})
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["error"]["type"] == \
+            "draining"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{src.port}/metrics", timeout=10,
+        ) as r:
+            assert "kubeinfer_engine_draining_state 1" in r.read().decode()
+
+        # resume on the target, chain-importing from the source
+        status, doc = self._post(dst.port, {
+            "prompt": p, "max_tokens": n_new,
+            "kubeinfer_resume": {
+                "tokens": toks,
+                "kv_source": f"http://127.0.0.1:{src.port}",
+            },
+        })
+        assert status == 200
+        assert doc["kubeinfer"]["route"] == "resume"
+        assert doc["choices"][0]["tokens"] == expect
+        assert doc["choices"][0]["finish_reason"] == "length"
+        assert dst_cont.imports_total >= 1
+        assert dst.metrics["kv_stream_blocks"].value("import") >= \
+            mig["blocks"]
+
+        # rebalance epilogue: drain resume=True on the (already empty)
+        # replica rejoins the fleet
+        status, report = self._post(
+            src.port, {"resume": True}, path="/admin/drain",
+        )
+        assert status == 200
+        assert report["drained"] and not report["draining"]
+        status, doc = self._post(
+            src.port, {"prompt": p, "max_tokens": 2},
+        )
+        assert status == 200
+
+    def test_degenerate_tail_resume_answers_directly(self, pair):
+        _, (dst, _) = pair
+        toks = [3, 4, 5, 6, 7]
+        status, doc = self._post(dst.port, {
+            "prompt": prompt_tokens(8), "max_tokens": 3,
+            "kubeinfer_resume": {"tokens": toks},
+        })
+        assert status == 200
+        assert doc["kubeinfer"]["route"] == "resume"
+        assert doc["choices"][0]["tokens"] == toks[:3]
+
+
+class TestRouterDraining:
+    def serving(self, queue_depth=0):
+        return {"queue_depth": queue_depth, "n_slots": 2}
+
+    def test_route_skips_draining_replica(self):
+        clk = SimulatedClock(start=100.0)
+        r = FleetRouter(clock=clk.now)
+        r.add_replica("a", "http://a")
+        r.add_replica("b", "http://b")
+        r.update_replica("a", self.serving())
+        r.update_replica("b", self.serving(queue_depth=4))
+        toks = list(range(8))
+        assert r.route(toks).replica == "a"  # less loaded
+        r.mark_draining("a")
+        d = r.route(toks)
+        assert d.replica == "b"
+        assert r.metrics["skipped"].value("a", "draining") >= 1
+        # the next authoritative refresh clears the local mark
+        r.update_replica("a", self.serving())
+        assert r.route(toks).replica == "a"
+
+
+@pytest.mark.slow
+class TestRouterMigration:
+    def _mk_fleet(self, params, names):
+        servers = {}
+        for name in names:
+            cont = mk_engine(params, migration_chunk_blocks=1)
+            srv = InferenceServer(
+                Engine(params, TINY), model_id=name, port=0,
+                continuous=cont,
+            ).start()
+            servers[name] = (srv, cont)
+        router = FleetRouter()
+        for name in names:
+            router.add_replica(
+                name, f"http://127.0.0.1:{servers[name][0].port}",
+            )
+        rs = RouterServer(router, port=0)
+        rs.poll_once()
+        return servers, router, rs
+
+    def _forward(self, rs, body):
+        code, payload = rs.forward(json.dumps(body).encode())
+        return code, json.loads(payload)
+
+    def _live_source(self, servers):
+        """Name of the replica holding a decoding slot with progress."""
+        for name, (_, cont) in servers.items():
+            if any(r is not None and len(r.out_tokens) >= 2
+                   for r in cont._slot_req):
+                return name
+        return None
+
+    def test_drain_reroutes_and_finishes_token_identical(self, params):
+        p = prompt_tokens(40, seed=71)
+        n_new = 48
+        ref = mk_engine(params)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1)
+        ref.stop()
+        servers, router, rs = self._mk_fleet(params, ("r0", "r1"))
+        try:
+            result = {}
+
+            def client():
+                result["code"], result["doc"] = self._forward(
+                    rs, {"prompt": p, "max_tokens": n_new},
+                )
+
+            t = threading.Thread(target=client)
+            t.start()
+            assert _wait_for(lambda: self._live_source(servers))
+            src = self._live_source(servers)
+            servers[src][0].drain(timeout_s=30.0)
+            t.join(120)
+            other = "r1" if src == "r0" else "r0"
+            assert result["code"] == 200
+            doc = result["doc"]
+            assert doc["choices"][0]["tokens"] == expect
+            assert doc["choices"][0]["finish_reason"] == "length"
+            assert doc["kubeinfer"]["replica"] == other
+            assert doc["kubeinfer"]["resume_hops"] == 1
+            assert router.metrics["migration_resumes"].value(other) \
+                == 1
+            # the source streamed its chain; the target imported it
+            assert len(servers[src][0].kv_exports) >= 1
+            assert servers[other][1].imports_total >= 1
+        finally:
+            for srv, cont in servers.values():
+                srv.stop()
+                cont.stop()
+
+    def test_drain_verdict_marks_and_reroutes(self, params):
+        """A request racing the drain flag gets the 503 typed verdict:
+        the proxy must mark the replica draining mid-request and land
+        the work elsewhere, not relay the 503 to the client."""
+        p = prompt_tokens(24, seed=72)
+        servers, router, rs = self._mk_fleet(params, ("r0", "r1"))
+        try:
+            servers["r0"][1].drain()
+            # push the router toward the draining replica: r1 looks
+            # heavily queued, r0 idle — only the 503 path saves this
+            router.update_replica(
+                "r1", dict(servers["r1"][1].stats_summary(),
+                           queue_depth=50),
+            )
+            code, doc = self._forward(
+                rs, {"prompt": p, "max_tokens": 3},
+            )
+            assert code == 200
+            assert doc["kubeinfer"]["replica"] == "r1"
+            assert router.metrics["requests"].value(
+                "r0", "draining") == 1
+            # the mark stuck: the next request skips r0 outright
+            code, doc = self._forward(
+                rs, {"prompt": p, "max_tokens": 3},
+            )
+            assert doc["kubeinfer"]["replica"] == "r1"
+            assert router.metrics["skipped"].value(
+                "r0", "draining") >= 1
+        finally:
+            servers["r0"][1].undrain()
+            for srv, cont in servers.values():
+                srv.stop()
+                cont.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestMigrationChaos:
+    def test_decode_replica_kill_mid_migration(self, params):
+        """Target dies between the drain hand-off and the resume: the
+        router must relay the parked partial (finish_reason=migrated,
+        no_target counted) — ZERO token loss — and a client-side
+        resume on the undrained source must finish token-identical,
+        warm off the blocks _migrate_slot parked in the trie."""
+        p = prompt_tokens(40, seed=81)
+        n_new = 48
+        ref = mk_engine(params)
+        expect = ref.generate(p, max_new_tokens=n_new, eos_id=-1)
+        ref.stop()
+        servers = {}
+        for name in ("r0", "r1"):
+            cont = mk_engine(params, migration_chunk_blocks=1)
+            srv = InferenceServer(
+                Engine(params, TINY), model_id=name, port=0,
+                continuous=cont,
+            ).start()
+            servers[name] = (srv, cont)
+        router = FleetRouter()
+        for name in servers:
+            router.add_replica(
+                name, f"http://127.0.0.1:{servers[name][0].port}",
+            )
+        rs = RouterServer(router, port=0)
+        rs.poll_once()
+        try:
+            result = {}
+
+            def client():
+                code, payload = rs.forward(json.dumps(
+                    {"prompt": p, "max_tokens": n_new},
+                ).encode())
+                result["code"] = code
+                result["doc"] = json.loads(payload)
+
+            t = threading.Thread(target=client)
+            t.start()
+            assert _wait_for(lambda: any(
+                any(r is not None and len(r.out_tokens) >= 2
+                    for r in cont._slot_req)
+                for _, cont in servers.values()
+            ))
+            src = next(
+                name for name, (_, cont) in servers.items()
+                if any(r is not None for r in cont._slot_req)
+            )
+            target = "r1" if src == "r0" else "r0"
+            # kill the resume target BEFORE the hand-off completes: the
+            # source's drain then has nowhere to send the session. The
+            # kill must be abrupt — a graceful stop() handshakes with
+            # serve_forever for up to its 0.5s poll interval, long
+            # enough for the source to finish the generation and the
+            # drain to find nothing left to migrate. Closing the
+            # listener socket refuses new connections instantly; the
+            # serve thread keeps polling harmlessly until the graceful
+            # stop in the finally block reaps it.
+            servers[target][0]._httpd.socket.close()
+            servers[src][0].drain(timeout_s=30.0)
+            t.join(120)
+            assert result["code"] == 200
+            doc = result["doc"]
+            assert doc["choices"][0]["finish_reason"] == "migrated"
+            toks = doc["choices"][0]["tokens"]
+            assert toks == expect[:len(toks)]
+            assert len(toks) >= 2
+            assert doc["kubeinfer"]["resume_hops"] == 1
+            assert router.metrics["migration_fallbacks"].value(
+                "no_target") == 1
+
+            # the client holds every token; resuming on the undrained
+            # source completes the generation exactly
+            servers[src][1].undrain()
+            code, payload = rs.forward(json.dumps({
+                "prompt": p, "max_tokens": n_new,
+                "kubeinfer_resume": {"tokens": toks},
+            }).encode())
+            assert code == 200
+            doc = json.loads(payload)
+            assert doc["choices"][0]["tokens"] == expect
+            assert doc["kubeinfer"]["replica"] == src
+            assert servers[src][1].imports_total == 0
+        finally:
+            for srv, cont in servers.values():
+                srv.stop()
+                cont.stop()

@@ -356,7 +356,12 @@ class TestEngineInstrumentation:
             "prefix_cached_tokens", "cache_summary",
             "tp_degree", "mesh_devices",
             "kv_dtype", "kv_pool_bytes",
+            "draining", "slo_burn",
         }
+        # idle engine, no SLO monitor, no drain in flight: both
+        # heartbeat signals sit at their resting values
+        assert s["draining"] is False
+        assert s["slo_burn"] == 0.0
         assert s["n_slots"] == 2
         # default engine runs the bf16 pool; pool bytes are static per
         # config and must be nonzero (the /metrics gauge leans on this)

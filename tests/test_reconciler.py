@@ -12,7 +12,11 @@ from kubeinfer_tpu.api.types import LLMService, LLMServiceSpec, SchedulerPolicy
 from kubeinfer_tpu.api.workload import NodeState, Workload
 from kubeinfer_tpu.controller import Controller
 from kubeinfer_tpu.controlplane import Store
-from kubeinfer_tpu.metrics import REGISTRY, reconcile_total
+from kubeinfer_tpu.metrics import (
+    REGISTRY,
+    evacuations_total,
+    reconcile_total,
+)
 from kubeinfer_tpu.utils.clock import SimulatedClock
 
 
@@ -329,3 +333,98 @@ class TestCrossPolicyCapacity:
         w_low = Workload.from_dict(store.get(Workload.KIND, "low"))
         assert w_high.replicas[0].node == "node-0"
         assert w_low.replicas[0].node == ""
+
+
+def set_serving(store, name, serving):
+    n = NodeState.from_dict(store.get(NodeState.KIND, name))
+    n.serving_stats = dict(serving)
+    store.update(NodeState.KIND, n.to_dict())
+
+
+class TestEvacuation:
+    """SLO-burn evacuation: the reconciler is live migration's third
+    caller. A node whose serving heartbeat reports slo_burn >= limit
+    gets its sessions drained OUT via the injected drainer — once per
+    burn episode, with failures retried next tick and everything
+    visible on kubeinfer_evacuations_total."""
+
+    def _controller(self, store, clock, drainer, limit=1.0):
+        return Controller(
+            store, clock=clock, slo_burn_limit=limit, drainer=drainer,
+        )
+
+    def test_burning_node_drained_once_per_episode(self):
+        store, clock, _ = setup(n_nodes=2)
+        calls = []
+        c = self._controller(store, clock, lambda n: calls.append(
+            n.metadata.name) or True)
+        before = evacuations_total.value("node-0", "drained")
+        set_serving(store, "node-0", {"slo_burn": 2.5})
+        res = c.reconcile_once()
+        assert res.evacuations == 1
+        assert calls == ["node-0"]
+        # the node stays hot for the whole drain; re-reconciling must
+        # not hammer /admin/drain (it would reset wait_drained clocks)
+        for _ in range(3):
+            assert c.reconcile_once().evacuations == 0
+        assert calls == ["node-0"]
+        assert evacuations_total.value("node-0", "drained") - before == 1
+
+    def test_episode_clears_when_burn_subsides(self):
+        store, clock, _ = setup(n_nodes=1)
+        calls = []
+        c = self._controller(store, clock, lambda n: calls.append(
+            n.metadata.name) or True)
+        set_serving(store, "node-0", {"slo_burn": 2.0})
+        c.reconcile_once()
+        # burn back under the limit: episode over, a fresh burn is a
+        # fresh episode and gets a fresh drain request
+        set_serving(store, "node-0", {"slo_burn": 0.1})
+        c.reconcile_once()
+        set_serving(store, "node-0", {"slo_burn": 3.0})
+        c.reconcile_once()
+        assert calls == ["node-0", "node-0"]
+
+    def test_failed_drain_stays_candidate_and_is_counted(self):
+        store, clock, _ = setup(n_nodes=1)
+        attempts = []
+
+        def flaky(n):
+            attempts.append(n.metadata.name)
+            if len(attempts) == 1:
+                raise RuntimeError("serving plane unreachable")
+            return True
+
+        c = self._controller(store, clock, flaky)
+        failed0 = evacuations_total.value("node-0", "failed")
+        drained0 = evacuations_total.value("node-0", "drained")
+        set_serving(store, "node-0", {"slo_burn": 2.0})
+        res = c.reconcile_once()
+        assert res.evacuations == 0  # the drainer raised
+        res = c.reconcile_once()  # still burning: retried next tick
+        assert res.evacuations == 1
+        assert attempts == ["node-0", "node-0"]
+        assert evacuations_total.value("node-0", "failed") - failed0 == 1
+        assert evacuations_total.value("node-0", "drained") - drained0 == 1
+
+    def test_already_draining_node_is_skipped(self):
+        """An operator-initiated drain (heartbeat reports draining)
+        must not be doubled by the reconciler, even above the limit."""
+        store, clock, _ = setup(n_nodes=1)
+        calls = []
+        c = self._controller(store, clock, lambda n: calls.append(
+            n.metadata.name) or True)
+        set_serving(store, "node-0", {"slo_burn": 9.0, "draining": True})
+        assert c.reconcile_once().evacuations == 0
+        assert calls == []
+
+    def test_disabled_without_limit_or_drainer(self):
+        store, clock, _ = setup(n_nodes=1)
+        set_serving(store, "node-0", {"slo_burn": 9.0})
+        calls = []
+        c = Controller(store, clock=clock, drainer=lambda n: calls.append(
+            n.metadata.name) or True)  # limit defaults to 0 = off
+        assert c.reconcile_once().evacuations == 0
+        c2 = Controller(store, clock=clock, slo_burn_limit=1.0)  # no drainer
+        assert c2.reconcile_once().evacuations == 0
+        assert calls == []
