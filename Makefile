@@ -6,8 +6,8 @@ PY ?= python
 # whatever JAX backend is live (real TPU chip if present).
 
 .PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
-        bench-full lint sanitize trace-demo run-manager run-agent \
-        docker-build clean
+        bench-full lint sanitize verify-flight trace-demo run-manager \
+        run-agent docker-build clean
 
 all: native lint test-fast
 
@@ -30,14 +30,16 @@ test-e2e: native
 # fault-injection scenarios (tests/test_chaos.py). Part of `test` too;
 # this target is the focused loop when iterating on failure handling.
 # Chaos-marked tests arm KUBEINFER_RACECHECK=2 via conftest, so the
-# lockset race detector and lock-order graph run as teardown oracles.
+# lockset race detector, lock-order graph, AND the lifecycle
+# ProtocolMonitor (analysis/protocol.py) run as teardown oracles.
 test-chaos:
 	$(PY) -m pytest tests/ -q -x -m chaos
 
 # Concurrency sanitizer (docs/ANALYSIS.md): 8 seeded deterministic
-# schedules per fuzz scenario with the lockset detector armed, then the
-# chaos tier under the same oracles. Bounded: the fuzzer serializes
-# tiny in-process scenarios (~seconds), no jit compiles involved.
+# schedules per fuzz scenario with the lockset detector and the live
+# protocol monitor armed, then the chaos tier under the same oracles.
+# Bounded: the fuzzer serializes tiny in-process scenarios (~seconds),
+# no jit compiles involved.
 sanitize:
 	$(PY) -m kubeinfer_tpu.analysis.schedfuzz --schedules 8
 	$(PY) -m pytest tests/ -q -x -m chaos
@@ -50,6 +52,19 @@ bench-quick: native
 
 bench-full: native
 	$(PY) bench.py --full
+
+# Offline leg of the lifecycle verifier: replay the newest bench flight
+# dump (bench.py serving_trace_bench writes bench_flight.json) against
+# the protocol spec. Exit 1 = illegal transition (both event sites
+# reported), exit 2 = no dump yet — run `make bench` first.
+verify-flight:
+	@f=$$(ls -t bench_flight*.json 2>/dev/null | head -1); \
+	if [ -z "$$f" ]; then \
+		echo "verify-flight: no bench_flight*.json (run 'make bench' first)" >&2; \
+		exit 2; \
+	fi; \
+	echo "replaying $$f"; \
+	$(PY) -m kubeinfer_tpu.analysis protocol "$$f"
 
 # Syntax (compileall) + invariant analyzer (kubeinfer_tpu/analysis/):
 # jit purity, static shapes under jit, lock discipline. Exits non-zero

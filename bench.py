@@ -707,6 +707,13 @@ def serving_trace_bench(n_requests=16, prompt_len=256, max_new=8,
                 [_tailed() for _ in range(n_requests)]
             )
             after = eng.kv_cache_stats()
+            # flight dump for `make verify-flight`: the offline leg of
+            # the lifecycle verifier replays this against the protocol
+            # spec. Written BEFORE stop() so the dump ends at steady
+            # state, and never on stdout — the one-JSON-line contract
+            # belongs to the driver.
+            with open("bench_flight.json", "w") as fh:
+                json.dump(eng.flight.to_dict(), fh)
         finally:
             eng.stop()
     finally:

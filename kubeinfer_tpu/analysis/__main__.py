@@ -6,6 +6,11 @@ and CI gate on it with no extra plumbing. With no paths, scans the
 default surface: the package, tests, bench.py, __graft_entry__.py, and
 scripts/ (ISSUE 2: bench code is where host-sync regressions hurt
 ``device_solve_ms`` most).
+
+``python -m kubeinfer_tpu.analysis protocol <flight.json>`` instead
+replays a FlightRecorder dump (``/debug/flightrecorder`` or bench's
+``bench_flight.json``) against the request lifecycle spec — the offline
+leg of the protocol verifier (see analysis/protocol.py).
 """
 
 from __future__ import annotations
@@ -22,6 +27,11 @@ _DEFAULT_PATHS = [
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "protocol":
+        from kubeinfer_tpu.analysis.protocol import main as protocol_main
+
+        return protocol_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m kubeinfer_tpu.analysis",
         description="kubeinfer_tpu invariant linter "

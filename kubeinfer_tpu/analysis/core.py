@@ -72,6 +72,26 @@ RULES = {
         "reachable while a lock is held — fix, or document the accepted "
         "latency ceiling in the allow reason"
     ),
+    "protocol-kind": (
+        "flight-recorder emit with a non-literal or spec-unknown kind, "
+        "or a KINDS vocabulary that drifted from the lifecycle spec "
+        "(analysis/protocol.py)"
+    ),
+    "protocol-detail": (
+        "flight-recorder emit missing a spec-required literal detail "
+        "key (notably the canonical request-id key `req` on every "
+        "per-request kind)"
+    ),
+    "protocol-order": (
+        "per-method emit sequence illegal under the lifecycle state "
+        "machine (e.g. retire before admit on one code path); loops "
+        "over distinct requests carry a reasoned allow"
+    ),
+    "donate-use": (
+        "host read of a value previously passed to a donate_argnums "
+        "jit without rebinding — the donated buffer is invalidated "
+        "(rebind the result over the name in the same statement)"
+    ),
     "unused-suppression": (
         "a `# lint: allow[rule]` whose rule no longer fires on its "
         "target line (stale suppressions rot; this finding is itself "
@@ -236,6 +256,7 @@ def analyze_source(
     path: str = "<string>",
     jit_registry: dict | None = None,
     boundary: bool | None = None,
+    donate_registry: dict | None = None,
 ) -> list[Finding]:
     """Analyze one file's source; returns UNSUPPRESSED findings only.
 
@@ -246,7 +267,8 @@ def analyze_source(
     # local imports: core is imported by racecheck users at runtime and
     # must not pay for the AST passes unless analysis actually runs
     from kubeinfer_tpu.analysis import (
-        blockcheck, jitlint, lockcheck, logdiscipline, metricnames,
+        blockcheck, donatecheck, jitlint, lockcheck, logdiscipline,
+        metricnames, protolint,
     )
 
     if boundary is None:
@@ -268,6 +290,11 @@ def analyze_source(
     findings.extend(lockcheck.run(tree, path))
     findings.extend(logdiscipline.run(tree, path))
     findings.extend(metricnames.run(tree, path))
+    # the lifecycle schema binds tests too: a fixture emitting a bogus
+    # kind or dropping the request id is exactly the drift protolint
+    # exists to count
+    findings.extend(protolint.run(tree, path))
+    findings.extend(donatecheck.run(tree, path, donate_registry))
     if not _is_test_file(path):
         # tests sleep/poll under fixture locks by design; the convoy
         # hazard only exists on library code paths
@@ -281,13 +308,14 @@ def analyze_source(
 
 def analyze_paths(paths) -> tuple[list[Finding], int]:
     """Two-phase scan over files/dirs; returns (findings, files_scanned)."""
-    from kubeinfer_tpu.analysis import jitlint
+    from kubeinfer_tpu.analysis import donatecheck, jitlint
 
     files = iter_py_files(paths)
     sources: dict[Path, str] = {}
     trees: dict[Path, ast.AST] = {}
     findings: list[Finding] = []
     registry: dict[str, frozenset] = {}
+    donations: dict[str, frozenset] = {}
     for f in files:
         try:
             src = _read(f)
@@ -299,8 +327,12 @@ def analyze_paths(paths) -> tuple[list[Finding], int]:
         sources[f] = src
         trees[f] = tree
         registry.update(jitlint.collect_jit_names(tree))
+        # donating jits cross files the same way (train.py calling a
+        # stepper.py donated step) — collect before the per-file passes
+        donations.update(donatecheck.collect_donations(tree))
     for f, tree in trees.items():
         findings.extend(
-            analyze_source(sources[f], str(f), jit_registry=registry)
+            analyze_source(sources[f], str(f), jit_registry=registry,
+                           donate_registry=donations)
         )
     return findings, len(files)

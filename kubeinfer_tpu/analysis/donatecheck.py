@@ -1,0 +1,310 @@
+"""Use-after-donation pass (``donate-use``).
+
+``donate_argnums`` hands a buffer's memory to XLA: after the jit call
+dispatches, the Python-side array is deleted and any host read raises
+(or, worse under some transfer paths, sees freed memory). The safe
+idiom — used everywhere in this repo — rebinds the result over the
+donated name in the SAME statement::
+
+    self._state = _decode(self.params, self._state, ...)   # clean
+    st = _decode(params, st, ...)                          # clean
+
+The bug class this flags is the off-lock variant the drain/migrate
+paths flirt with: donate, do other work, then read the stale name::
+
+    out = _decode(params, st, ...)       # st donated, NOT rebound
+    toks = np.asarray(st.tokens)         # donate-use
+
+Model: a linear walk per function scope. A call to a known donating
+jit (collected repo-wide, decorator + ``jax.jit(fn, donate_argnums=)``
+call forms — ``donate_argnums`` positions only; positional args at the
+call site) kills the exact dotted name passed in each donated position.
+Assignment to the name (or a prefix of it) resurrects it, including
+the same-statement rebind above, because kills from a statement's value
+are applied before its targets bind. Reads of a dead name — or of any
+attribute under it except shape/dtype-style metadata — are findings.
+``if``/``else`` branches merge as a union of their kill sets (minus
+branches that return/raise); loop bodies walk twice so a kill at the
+bottom reaches a read at the top on the next iteration. Aliasing
+(``other = st`` before the donation) and reads from nested closures are
+out of scope — name-based, like the rest of the analysis passes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeinfer_tpu.analysis.core import Finding
+from kubeinfer_tpu.analysis.jitlint import _dotted
+
+__all__ = ["collect_donations", "run"]
+
+# attribute tails that read host metadata, legal even on a donated value
+# (the Python object survives; only the device buffer is gone)
+_META_ATTRS = {
+    "shape", "dtype", "ndim", "size", "weak_type", "sharding", "aval",
+    "itemsize", "nbytes",
+}
+
+
+def _donate_nums(call: ast.Call) -> frozenset:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset({v.value})
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return frozenset(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int))
+    return frozenset()
+
+
+def _decorator_donations(dec: ast.AST) -> frozenset | None:
+    """Donated positions if ``dec`` jit-compiles with donation, else
+    None. Same forms as jitlint: ``@jax.jit(...)``,
+    ``@functools.partial(jax.jit, ...)``, ``@partial(jax.jit, ...)``."""
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = _dotted(dec.func)
+    if fn == "jax.jit":
+        return _donate_nums(dec) or None
+    if fn in ("functools.partial", "partial") and dec.args:
+        if _dotted(dec.args[0]) == "jax.jit":
+            return _donate_nums(dec) or None
+    return None
+
+
+def collect_donations(tree: ast.AST) -> dict:
+    """Map of bare function NAME -> frozenset of donated arg positions,
+    for every donating jit in the tree (decorator and call forms)."""
+    out: dict[str, frozenset] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                nums = _decorator_donations(dec)
+                if nums:
+                    out[node.name] = nums
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if isinstance(v, ast.Call) and _dotted(v.func) == "jax.jit":
+                nums = _donate_nums(v)
+                if nums:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.setdefault(tgt.id, nums)
+    return out
+
+
+class _Walk:
+    def __init__(self, path, findings, registry) -> None:
+        self.path = path
+        self.findings = findings
+        self.registry = registry
+        self.dead: dict = {}  # dotted name -> (jit_name, donate_line)
+        self._seen: set = set()  # (line, key) — loops walk twice
+
+    # -- per-statement phases ---------------------------------------------
+
+    def _donations(self, st) -> list:
+        """(key, jit_name, line, exempt_node) per donated Name/Attribute
+        argument in the statement."""
+        out = []
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain:
+                continue
+            nums = self.registry.get(chain.split(".")[-1])
+            if not nums:
+                continue
+            for i in nums:
+                if i < len(node.args):
+                    a = node.args[i]
+                    key = _dotted(a)
+                    if key:
+                        out.append((key, chain, node.lineno, a))
+        return out
+
+    def _reads(self, st, exempt, skip_targets) -> None:
+        """Flag Load-context dotted reads of dead names. ``exempt`` are
+        the donation-argument nodes themselves (the donating read is the
+        point); ``skip_targets`` are assignment-target subtrees."""
+        skip = set(map(id, exempt)) | set(map(id, skip_targets))
+
+        def visit(node):
+            if id(node) in skip:
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # closures: out of scope (module docstring)
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                key = _dotted(node)
+                if key is not None:
+                    self._check_read(key, node.lineno)
+                    # the chain is one read; but a Subscript/Call below
+                    # an Attribute base still needs visiting
+                    base = node
+                    while isinstance(base, ast.Attribute):
+                        base = base.value
+                    if not isinstance(base, ast.Name):
+                        visit(base)
+                    return
+            for ch in ast.iter_child_nodes(node):
+                visit(ch)
+
+        visit(st)
+
+    def _check_read(self, key: str, line: int) -> None:
+        for dead, (jit_name, dline) in self.dead.items():
+            if key == dead:
+                tail = None
+            elif key.startswith(dead + "."):
+                tail = key[len(dead) + 1:].split(".")[0]
+                if tail in _META_ATTRS:
+                    continue
+            else:
+                continue
+            mark = (line, dead)
+            if mark in self._seen:
+                return
+            self._seen.add(mark)
+            what = key if tail is None else f"{key} (under {dead})"
+            self.findings.append(Finding(
+                self.path, line, "donate-use",
+                f"`{what}` read after being donated to jit "
+                f"{jit_name.split('.')[-1]!r} at line {dline} — the "
+                f"buffer is invalidated by donation; rebind the call's "
+                f"result before reading"))
+            return
+
+    def _resurrect(self, key: str) -> None:
+        # rebinding a name revives it and everything under it; binding
+        # a SUB-attribute of a dead object does not revive the parent
+        for dead in [d for d in self.dead
+                     if d == key or d.startswith(key + ".")]:
+            del self.dead[dead]
+
+    def _bind_targets(self, targets) -> None:
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                self._bind_targets(tgt.elts)
+            elif isinstance(tgt, ast.Starred):
+                self._bind_targets([tgt.value])
+            elif isinstance(tgt, (ast.Name, ast.Attribute)):
+                key = _dotted(tgt)
+                if key:
+                    self._resurrect(key)
+
+    def _simple(self, st, targets=()) -> None:
+        """kills-from-value before targets-bind: the same-statement
+        rebind idiom stays clean by construction."""
+        dons = self._donations(st)
+        self._reads(st, [d[3] for d in dons], list(targets))
+        for key, jit_name, line, _ in dons:
+            self.dead[key] = (jit_name, line)
+        self._bind_targets(list(targets))
+
+    # -- control flow ------------------------------------------------------
+
+    def stmts(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # separate scope (run() walks every def)
+        if isinstance(st, ast.Assign):
+            self._simple(st, st.targets)
+        elif isinstance(st, ast.AnnAssign):
+            self._simple(st, [st.target] if st.value is not None else [])
+        elif isinstance(st, ast.AugAssign):
+            # x += f(...) READS x first (target ctx is Store, so the
+            # Load walk misses it — check explicitly)
+            key = _dotted(st.target)
+            if key:
+                self._check_read(key, st.lineno)
+            self._simple(st, [])
+            self._bind_targets([st.target])
+        elif isinstance(st, ast.If):
+            self._simple(st.test)
+            before = dict(self.dead)
+            self.stmts(st.body)
+            body_dead, body_term = self.dead, _terminates(st.body)
+            self.dead = dict(before)
+            self.stmts(st.orelse)
+            or_dead, or_term = self.dead, _terminates(st.orelse)
+            if body_term and not or_term:
+                self.dead = or_dead
+            elif or_term and not body_term:
+                self.dead = body_dead
+            else:
+                merged = dict(or_dead)
+                merged.update(body_dead)
+                self.dead = merged
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._simple(st.iter)
+            self._bind_targets([st.target])
+            # second walk: a kill at the loop bottom reaches reads at
+            # the top on the next iteration (dedup via _seen)
+            for _ in range(2):
+                self.stmts(st.body)
+                self._bind_targets([st.target])
+            self.stmts(st.orelse)
+        elif isinstance(st, ast.While):
+            self._simple(st.test)
+            for _ in range(2):
+                self.stmts(st.body)
+                self._simple(st.test)
+            self.stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._simple(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_targets([item.optional_vars])
+            self.stmts(st.body)
+        elif isinstance(st, ast.Try) or st.__class__.__name__ == "TryStar":
+            self.stmts(st.body)
+            for h in st.handlers:
+                self.stmts(h.body)
+            self.stmts(st.orelse)
+            self.stmts(st.finalbody)
+        elif isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                key = _dotted(tgt)
+                if key:
+                    self._resurrect(key)  # explicit del: nothing to read
+        elif isinstance(st, ast.Match):
+            self._simple(st.subject)
+            before = dict(self.dead)
+            merged = dict(before)
+            for case in st.cases:
+                self.dead = dict(before)
+                self.stmts(case.body)
+                merged.update(self.dead)
+            self.dead = merged
+        else:
+            # Expr/Return/Raise/Assert/Global/Pass/...: reads + kills
+            self._simple(st)
+
+
+def _terminates(body) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise))
+
+
+def run(tree: ast.AST, path: str,
+        donate_registry: dict | None = None) -> list:
+    registry = dict(donate_registry or {})
+    registry.update(collect_donations(tree))
+    if not registry:
+        return []
+    findings: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _Walk(path, findings, registry)
+            w.stmts(node.body)
+    return findings

@@ -1,0 +1,433 @@
+"""Request-lifecycle protocol: ONE declarative state machine, enforced
+three ways.
+
+The reference is, at heart, a state reconciler — llmservice_controller.go
+(66-174, /root/reference/) forces observed replica counts through a
+declared lifecycle. Our unit of reconciliation is finer: a *request's*
+KV state (submit → chunked admit → fused decode windows → preempt/resume
+→ retire, plus drain/migrate and disagg import). Until this module, the
+legality rules lived nowhere: ``flightrecorder.KINDS`` was a flat
+vocabulary and "exactly one terminal state per request" was hand-copied
+into six schedfuzz verifies. This module is the single source of truth:
+
+- **states**: ``queued → prefilling → active ⇄ parked`` with the three
+  terminals ``done`` / ``failed`` / ``migrated``. Terminal states have
+  no outgoing transitions, which IS the exactly-one-terminal rule —
+  a second terminal event is an ``after-terminal`` violation, not a
+  separately maintained invariant.
+- **transitions**: each flight-recorder kind is either *per-request*
+  (carries the canonical request-id detail key ``req`` and moves one
+  chain through the machine) or *engine-level* (pool/drain bookkeeping,
+  no chain). ``migrate*`` kinds additionally guard on an open drain
+  window (``drain_start`` seen without a closing ``drain_end``).
+- **required detail keys**: the per-kind schema the static pass
+  (protolint) checks as literals at every emit site and the runtime
+  monitor re-checks on every event.
+
+Enforced by: (1) the ``protolint`` AST pass (protolint.py) at lint
+time, (2) :class:`ProtocolMonitor` replaying live FlightRecorder events
+as tests run (armed for chaos tests in tests/conftest.py and for every
+schedfuzz run in run_scenario), and (3) the offline CLI
+(``python -m kubeinfer_tpu.analysis protocol <flight.json>``) over
+``/debug/flightrecorder`` dumps and bench traces.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from kubeinfer_tpu.analysis.racecheck import make_lock
+
+__all__ = [
+    "REQ_KEY",
+    "SPEC",
+    "KindSpec",
+    "STATES",
+    "TERMINAL_STATES",
+    "PER_REQUEST_KINDS",
+    "ENGINE_KINDS",
+    "may_follow",
+    "required_keys",
+    "Violation",
+    "ProtocolReport",
+    "replay_events",
+    "assert_conformant",
+    "ProtocolMonitor",
+    "main",
+]
+
+# THE canonical request-id detail key. Every per-request emit carries
+# exactly this literal name (protolint's schema check counts drift);
+# the runtime replay keys chains on it.
+REQ_KEY = "req"
+
+# Chain states. "new" is the implicit pre-submit state — a chain exists
+# only once its submit event is observed.
+STATES = ("new", "queued", "prefilling", "active", "parked",
+          "done", "failed", "migrated")
+TERMINAL_STATES = frozenset({"done", "failed", "migrated"})
+_NON_TERMINAL = ("queued", "prefilling", "active", "parked")
+
+
+@dataclass(frozen=True)
+class KindSpec:
+    """One flight-recorder kind's place in the lifecycle machine."""
+
+    kind: str
+    # legal pre-states for a chain observing this kind ("new" = chain
+    # start); empty tuple = engine-level kind, no chain involvement
+    sources: tuple
+    # post-state ("" for engine-level kinds)
+    target: str
+    # detail keys protolint requires as LITERAL keywords at emit sites
+    # and the monitor requires present at runtime
+    required: tuple
+    # guard: only legal while a drain window is open (drain_start seen,
+    # no closing drain_end). Checked by the runtime/offline replay on
+    # complete rings; a truncated ring may have lost the drain_start,
+    # so the guard stands down under truncation.
+    requires_draining: bool = False
+
+    @property
+    def per_request(self) -> bool:
+        return bool(self.sources)
+
+
+# The one declarative spec. Transition notes name the emit sites so the
+# machine stays auditable against batching.py:
+#   submit        ContinuousEngine.submit (before the queue publish)
+#   chunk         _step_prefill — one chunked-prefill dispatch
+#   admit/resume  _finalize_admit (fresh admission vs parked readmit /
+#                 migration hand-off resume)
+#   preempt       _park_slot
+#   backpressure  _plan_kv — admission held, request stays queued
+#   retire        _maybe_retire and _abort_prefill (cancel mid-prefill)
+#   fail          stop()/_fail_inflight per-request sweeps
+#   migrate*      _step_drain / _mark_migrated (drain window only)
+SPEC = {
+    s.kind: s for s in (
+        KindSpec("submit", ("new",), "queued",
+                 (REQ_KEY, "prompt_tokens", "max_new")),
+        # chunked prefill may start from the queue, from a parked
+        # readmit, or continue a running chunk sequence
+        KindSpec("chunk", ("queued", "parked", "prefilling"),
+                 "prefilling", (REQ_KEY, "slot")),
+        KindSpec("admit", ("queued", "prefilling"), "active",
+                 (REQ_KEY, "slot")),
+        KindSpec("resume", ("queued", "parked", "prefilling"), "active",
+                 (REQ_KEY, "slot")),
+        KindSpec("preempt", ("active",), "parked", (REQ_KEY, "slot")),
+        KindSpec("backpressure", ("queued",), "queued",
+                 (REQ_KEY, "reason")),
+        # _abort_prefill retires a cancelled chunked prefill before the
+        # row ever activates, hence the prefilling source
+        KindSpec("retire", ("active", "prefilling"), "done",
+                 (REQ_KEY, "slot", "tokens")),
+        KindSpec("fail", _NON_TERMINAL, "failed", (REQ_KEY, "reason")),
+        # queued/parked work migrates with zero streamed blocks; a live
+        # slot migrates after its stream caught up
+        KindSpec("migrate", ("queued", "parked", "active"), "migrated",
+                 (REQ_KEY, "blocks"), requires_draining=True),
+        KindSpec("migrate_chunk", ("active",), "active",
+                 (REQ_KEY, "slot", "blocks"), requires_draining=True),
+        KindSpec("migrate_sink_error", ("active",), "active",
+                 (REQ_KEY, "slot"), requires_draining=True),
+        # engine-level kinds: pool and drain bookkeeping, no chain
+        KindSpec("evict", (), "", ("nodes",)),
+        KindSpec("fail_inflight", (), "", ("failed",)),
+        KindSpec("import_staged", (), "", ("blocks",)),
+        KindSpec("import", (), "", ("blocks",)),
+        KindSpec("import_reject", (), "", ("blocks", "reason")),
+        KindSpec("drain_start", (), "", ()),
+        KindSpec("drain_end", (), "", ()),
+    )
+}
+
+PER_REQUEST_KINDS = frozenset(k for k, s in SPEC.items() if s.per_request)
+ENGINE_KINDS = frozenset(k for k, s in SPEC.items() if not s.per_request)
+
+
+def required_keys(kind: str) -> tuple:
+    return SPEC[kind].required if kind in SPEC else ()
+
+
+def may_follow(a: str, b: str) -> bool:
+    """Whether kind ``b`` can legally follow kind ``a`` for ONE request
+    — the relation protolint's per-method emit-order check consults.
+    Engine-level kinds order freely."""
+    sa, sb = SPEC.get(a), SPEC.get(b)
+    if sa is None or sb is None:
+        return True  # unknown kinds get their own finding, not this one
+    if not sa.per_request or not sb.per_request:
+        return True
+    return sa.target in sb.sources
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One protocol breach, carrying BOTH event sites (the previous
+    event on the chain and the offending one) so a post-mortem jumps
+    straight to the pair."""
+
+    rule: str  # unknown-kind | missing-detail | illegal-transition |
+    #            after-terminal | chain-start | guard-draining
+    rid: object
+    message: str
+    event: dict | None = None  # offending event (seq/t/kind/detail)
+    prev: dict | None = None  # previous event on the same chain
+
+    def render(self) -> str:
+        def site(e):
+            if e is None:
+                return "<none>"
+            return f"seq={e.get('seq')} t={e.get('t'):.6f} {e.get('kind')}"
+
+        loc = f" at [{site(self.event)}]"
+        if self.prev is not None:
+            loc += f" after [{site(self.prev)}]"
+        return f"{self.rule} req={self.rid!r}: {self.message}{loc}"
+
+
+def _evd(ev) -> dict:
+    """Normalize a FlightEvent or a ``to_dict()`` event dict."""
+    if isinstance(ev, dict):
+        return ev
+    return {"seq": ev.seq, "t": ev.t, "kind": ev.kind,
+            "detail": dict(ev.detail)}
+
+
+class _Replayer:
+    """Per-recorder replay of the machine: one instance per event
+    stream, shared by the offline report and the live monitor. Not
+    thread-safe on its own — callers serialize (the monitor under its
+    lock; offline replay is single-threaded)."""
+
+    def __init__(self, truncated: bool = False) -> None:
+        self.truncated = truncated
+        self.state: dict = {}  # rid -> state name
+        self.prev: dict = {}  # rid -> last event dict on the chain
+        self.draining = False
+        self.violations: list[Violation] = []
+
+    def feed(self, ev) -> None:
+        e = _evd(ev)
+        kind = e.get("kind")
+        detail = e.get("detail") or {}
+        spec = SPEC.get(kind)
+        if spec is None:
+            self.violations.append(Violation(
+                "unknown-kind", None,
+                f"kind {kind!r} is not in the lifecycle spec", e))
+            return
+        missing = [k for k in spec.required if k not in detail]
+        if missing:
+            self.violations.append(Violation(
+                "missing-detail", detail.get(REQ_KEY),
+                f"{kind} lacks required detail key(s) {missing}", e))
+        if not spec.per_request:
+            if kind == "drain_start":
+                self.draining = True
+            elif kind == "drain_end":
+                self.draining = False
+            return
+        rid = detail.get(REQ_KEY)
+        if rid is None:
+            return  # missing-detail already reported; no chain to move
+        if spec.requires_draining and not self.draining \
+                and not self.truncated:
+            self.violations.append(Violation(
+                "guard-draining", rid,
+                f"{kind} outside an open drain window",
+                e, self.prev.get(rid)))
+        cur = self.state.get(rid, "new")
+        if cur == "new" and "new" not in spec.sources:
+            if self.truncated:
+                # the ring dropped this chain's head: adopt the state
+                # the event implies and keep checking from here
+                self.state[rid] = spec.target
+                self.prev[rid] = e
+                return
+            self.violations.append(Violation(
+                "chain-start", rid,
+                f"chain begins with {kind} (expected submit)", e))
+            self.state[rid] = spec.target
+            self.prev[rid] = e
+            return
+        if cur in TERMINAL_STATES:
+            self.violations.append(Violation(
+                "after-terminal", rid,
+                f"{kind} after the chain already reached "
+                f"terminal state {cur!r}", e, self.prev.get(rid)))
+            # chain stays terminal: later events keep reporting
+            self.prev[rid] = e
+            return
+        if cur not in spec.sources:
+            self.violations.append(Violation(
+                "illegal-transition", rid,
+                f"{kind} is illegal from state {cur!r} "
+                f"(legal sources: {', '.join(spec.sources)})",
+                e, self.prev.get(rid)))
+        self.state[rid] = spec.target
+        self.prev[rid] = e
+
+
+@dataclass
+class ProtocolReport:
+    violations: list = field(default_factory=list)
+    chains: dict = field(default_factory=dict)  # rid -> final state
+    events: int = 0
+    truncated: bool = False
+
+    def open_chains(self) -> list:
+        return sorted(
+            (rid for rid, s in self.chains.items()
+             if s not in TERMINAL_STATES),
+            key=repr,
+        )
+
+    def render(self) -> str:
+        lines = [v.render() for v in self.violations]
+        lines.append(
+            f"{self.events} event(s), {len(self.chains)} request "
+            f"chain(s), {len(self.open_chains())} open, "
+            f"{len(self.violations)} violation(s)"
+            + (" [ring truncated]" if self.truncated else "")
+        )
+        return "\n".join(lines)
+
+
+def replay_events(events, truncated: bool = False) -> ProtocolReport:
+    """Replay a sequence of flight events (FlightEvent objects or
+    ``to_dict()`` dicts, oldest first) through the spec. ``truncated``
+    says the ring dropped its oldest events (``recorded > capacity``):
+    chains may then start mid-flight and the drain-window guard stands
+    down."""
+    r = _Replayer(truncated=truncated)
+    n = 0
+    for ev in events:
+        r.feed(ev)
+        n += 1
+    return ProtocolReport(
+        violations=r.violations, chains=dict(r.state), events=n,
+        truncated=truncated,
+    )
+
+
+def replay_dump(dump: dict) -> ProtocolReport:
+    """Replay a ``FlightRecorder.to_dict()`` dump (the
+    ``/debug/flightrecorder`` wire shape: capacity/recorded/events)."""
+    events = dump.get("events", [])
+    recorded = int(dump.get("recorded", len(events)))
+    return replay_events(events, truncated=recorded > len(events))
+
+
+def assert_conformant(recorder_or_events, expect=None) -> ProtocolReport:
+    """The spec-driven terminal-state oracle the schedfuzz scenarios
+    verify with: no protocol violation, every chain reached exactly one
+    terminal state, and (when ``expect`` is given) the chain set is
+    exactly those request ids. Replaces the hand-copied
+    ``sorted(served + failed) == range(n)`` asserts — a double-serve is
+    an after-terminal violation, a lost request an open chain, a
+    phantom request a set mismatch."""
+    events = (recorder_or_events.snapshot()
+              if hasattr(recorder_or_events, "snapshot")
+              else list(recorder_or_events))
+    rep = replay_events(events)
+    assert not rep.violations, "protocol violations:\n" + rep.render()
+    open_ = rep.open_chains()
+    assert not open_, (
+        f"request chain(s) {open_} never reached a terminal state:\n"
+        + rep.render()
+    )
+    if expect is not None:
+        want = sorted(expect, key=repr)
+        got = sorted(rep.chains, key=repr)
+        assert got == want, f"request chains {got} != expected {want}"
+    return rep
+
+
+class ProtocolMonitor:
+    """Live oracle: observes every FlightRecorder event as it is noted
+    (``flightrecorder.set_monitor``) and replays each recorder's stream
+    against the spec. Violations are RECORDED, never raised — an
+    exception inside ``note()`` would crash the scheduler thread mid-
+    handoff; the arming fixture asserts ``violations`` empty at
+    teardown instead. Per-recorder streams arrive in seq order because
+    the hook runs under the recorder's own lock; chains are keyed
+    (recorder uid, request id) so two engines in one test never alias.
+    Live observation never sees ring truncation, so the full machine —
+    including the drain-window guard — is armed."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("analysis.protocol.ProtocolMonitor._lock")
+        self._streams: dict = {}  # recorder uid -> _Replayer
+
+    def observe(self, recorder, event) -> None:
+        uid = getattr(recorder, "uid", id(recorder))
+        with self._lock:
+            rep = self._streams.get(uid)
+            if rep is None:
+                rep = self._streams[uid] = _Replayer(truncated=False)
+            rep.feed(event)
+
+    @property
+    def violations(self) -> list:
+        with self._lock:
+            return [v for r in self._streams.values()
+                    for v in r.violations]
+
+    def render(self) -> str:
+        return "\n".join(v.render() for v in self.violations) or "<clean>"
+
+    def assert_clean(self) -> None:
+        vs = self.violations
+        assert not vs, "lifecycle protocol violations:\n" + "\n".join(
+            v.render() for v in vs
+        )
+
+
+def main(argv=None) -> int:
+    """Offline checker: ``python -m kubeinfer_tpu.analysis protocol
+    <flight.json> [...]``. Validates ``/debug/flightrecorder`` dumps
+    and bench-produced traces (``bench_flight.json``); prints the first
+    illegal transition WITH both event sites and exits non-zero on any
+    violation."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m kubeinfer_tpu.analysis protocol",
+        description="replay a FlightRecorder dump against the request "
+                    "lifecycle protocol spec")
+    ap.add_argument("dumps", nargs="+",
+                    help="flight dump JSON files (to_dict() shape or a "
+                         "bare event list)")
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.dumps:
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            # lint: allow[log-discipline] CLI surface: the report IS the output contract, not a log line
+            print(f"{path}: unreadable flight dump: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        rep = (replay_dump(data) if isinstance(data, dict)
+               else replay_events(data))
+        tag = f"{path}: "
+        if rep.violations:
+            rc = rc or 1
+            first = rep.violations[0]
+            # lint: allow[log-discipline] CLI surface: the report IS the output contract, not a log line
+            print(tag + "FIRST VIOLATION " + first.render())
+            for v in rep.violations[1:]:
+                # lint: allow[log-discipline] CLI surface: the report IS the output contract, not a log line
+                print(tag + v.render())
+            # lint: allow[log-discipline] CLI surface: the report IS the output contract, not a log line
+            print(tag + rep.render().splitlines()[-1], file=sys.stderr)
+        else:
+            # lint: allow[log-discipline] CLI surface: the report IS the output contract, not a log line
+            print(tag + rep.render(), file=sys.stderr)
+    return rc

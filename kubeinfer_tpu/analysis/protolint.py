@@ -1,0 +1,326 @@
+"""Static leg of the lifecycle protocol verifier (protocol.py).
+
+Three rules over every ``flight.note(...)`` / ``self._note(...)`` emit
+site:
+
+- ``protocol-kind``: the kind must be a LITERAL string the spec
+  declares. Also fired on ``KINDS`` drift — any module assigning a
+  top-level ``KINDS`` tuple is checked for set-equality against the
+  spec in both directions, so a kind added to the recorder vocabulary
+  without a declared transition (or vice versa) fails lint, which is
+  the "every KINDS entry reachable in the spec" rule.
+- ``protocol-detail``: the spec's required detail keys — notably the
+  canonical request-id key ``req`` on every per-request kind — must
+  appear as literal keyword arguments at the emit site. A ``**detail``
+  splat defers the check to the runtime monitor (the forwarding wrapper
+  pattern); so does a non-literal kind inside a function itself named
+  ``note``/``_note``.
+- ``protocol-order``: within one method, consecutive per-request emits
+  on any straight-line path must be a legal transition sequence
+  (``may_follow``). Branches of an ``if`` are alternatives, not a
+  sequence; a branch that returns/raises contributes no successor.
+  Loop back-edges are deliberately NOT paired — a loop that emits once
+  per *distinct* request (a fail sweep, a submitter) would otherwise
+  flag on every iteration boundary, drowning the real bug class this
+  rule targets: two emits for the same request written in the wrong
+  order on one code path. Consecutive sibling loops DO pair (last emits
+  of one against first emits of the next), which is exactly where the
+  ``_fail_inflight`` sweeps need their reasoned allows.
+
+The walk is syntactic and name-based (any ``.note``/``._note`` call):
+the FlightRecorder API is the only ``note`` verb in this codebase, and
+a false positive costs one reasoned allow, while a missed emit site
+silently exempts a lifecycle event from the schema.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeinfer_tpu.analysis.core import Finding
+from kubeinfer_tpu.analysis.protocol import (
+    PER_REQUEST_KINDS, SPEC, may_follow,
+)
+
+__all__ = ["run"]
+
+_NOTE_NAMES = ("note", "_note")
+
+
+def _note_kind(call: ast.Call):
+    """(is_note_call, literal_kind_or_None) for a Call node."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if name not in _NOTE_NAMES:
+        return False, None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return True, call.args[0].value
+    return True, None
+
+
+class _Emit:
+    """One literal-kind emit site."""
+
+    __slots__ = ("kind", "line")
+
+    def __init__(self, kind: str, line: int) -> None:
+        self.kind = kind
+        self.line = line
+
+
+class _Seq:
+    """Emit-order summary of a statement sequence: the emits that can
+    run first, the emits that can run last, whether an emit definitely
+    runs, and whether the sequence definitely terminates (return/raise
+    on every path)."""
+
+    __slots__ = ("first", "last", "definite", "terminated")
+
+    def __init__(self, first=(), last=(), definite=False, terminated=False):
+        self.first = set(first)
+        self.last = set(last)
+        self.definite = definite
+        self.terminated = terminated
+
+
+def _check_call(call: ast.Call, path, findings, in_note_def) -> _Emit | None:
+    """Schema-check one note call; returns an _Emit for per-request
+    literal kinds (the order pass's alphabet), else None."""
+    is_note, kind = _note_kind(call)
+    if not is_note:
+        return None
+    if kind is None:
+        if not in_note_def:
+            findings.append(Finding(
+                path, call.lineno, "protocol-kind",
+                "note() kind is not a literal string — the lifecycle "
+                "schema cannot be checked statically (forwarding "
+                "wrappers must be named note/_note)"))
+        return None
+    spec = SPEC.get(kind)
+    if spec is None:
+        findings.append(Finding(
+            path, call.lineno, "protocol-kind",
+            f"kind {kind!r} is not declared in the lifecycle spec "
+            f"(analysis/protocol.py SPEC)"))
+        return None
+    if any(kw.arg is None for kw in call.keywords):
+        # **detail splat: keys unknowable statically; the runtime
+        # monitor still enforces the schema on every event
+        return _Emit(kind, call.lineno) if kind in PER_REQUEST_KINDS else None
+    present = {kw.arg for kw in call.keywords}
+    missing = [k for k in spec.required if k not in present]
+    if missing:
+        findings.append(Finding(
+            path, call.lineno, "protocol-detail",
+            f"{kind} emit lacks required literal detail key(s) "
+            f"{missing}"))
+    return _Emit(kind, call.lineno) if kind in PER_REQUEST_KINDS else None
+
+
+def _stmt_emits(st, path, findings, in_note_def) -> list:
+    """Emits appearing in ONE simple statement, in AST order (nested
+    defs/lambdas excluded — separate scopes)."""
+    out = []
+    stack = [st]
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            e = _check_call(node, path, findings, in_note_def)
+            if e is not None:
+                out.append(e)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _OrderWalk:
+    """Per-function emit-order analysis (see module docstring for the
+    pairing rules)."""
+
+    def __init__(self, path, findings, in_note_def) -> None:
+        self.path = path
+        self.findings = findings
+        self.in_note_def = in_note_def
+        self._flagged: set = set()  # (line, a.kind, b.kind) dedupe
+
+    def _pair(self, a: _Emit, b: _Emit) -> None:
+        if may_follow(a.kind, b.kind):
+            return
+        key = (b.line, a.kind, b.kind)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        tgt = SPEC[a.kind].target
+        self.findings.append(Finding(
+            self.path, b.line, "protocol-order",
+            f"{b.kind} emit (line {b.line}) cannot follow {a.kind} "
+            f"(line {a.line}) for one request: state {tgt!r} is not in "
+            f"{b.kind}'s legal sources"))
+
+    def seq(self, body) -> _Seq:
+        out = _Seq()
+        open_ = set()  # emits whose successor hasn't been seen yet
+        for st in body:
+            s = self.stmt(st)
+            for a in open_:
+                for b in s.first:
+                    self._pair(a, b)
+            if not out.definite:
+                out.first |= s.first
+            if s.definite:
+                out.definite = True
+            open_ = set(s.last) | (set() if s.definite else open_)
+            if s.terminated:
+                out.terminated = True
+                open_ = set()
+                break  # following statements are unreachable
+        out.last = open_
+        return out
+
+    def stmt(self, st) -> _Seq:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return _Seq()  # separate scope, analyzed on its own
+        if isinstance(st, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+            emits = _stmt_emits(st, self.path, self.findings,
+                                self.in_note_def)
+            s = self._chain(emits)
+            s.terminated = True
+            return s
+        if isinstance(st, ast.If):
+            b = self.seq(st.body)
+            o = self.seq(st.orelse)
+            test = self._chain(_stmt_emits(
+                st.test, self.path, self.findings, self.in_note_def))
+            for branch in (b, o):
+                for a in test.last:
+                    for x in branch.first:
+                        self._pair(a, x)
+            first = set(test.first) or (b.first | o.first)
+            last = set()
+            if not b.terminated:
+                last |= b.last or (test.last if not b.definite else set())
+            if not o.terminated:
+                last |= o.last or (test.last if not o.definite else set())
+            return _Seq(
+                first if test.definite else first | b.first | o.first,
+                last,
+                definite=test.definite or (b.definite and o.definite
+                                           and bool(st.orelse)),
+                terminated=b.terminated and o.terminated and bool(st.orelse),
+            )
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            head = self._chain(_stmt_emits(
+                getattr(st, "iter", None) or st.test,
+                self.path, self.findings, self.in_note_def))
+            body = self.seq(st.body)
+            for a in head.last:
+                for b in body.first:
+                    self._pair(a, b)
+            self.seq(st.orelse)
+            # no back-edge pairs (module docstring); the loop may run
+            # zero times, so it is never definite and the head's lasts
+            # stay open alongside the body's
+            return _Seq(head.first | body.first,
+                        head.last | body.last, definite=False)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            head = self._chain([
+                e for item in st.items
+                for e in _stmt_emits(item, self.path, self.findings,
+                                     self.in_note_def)])
+            body = self.seq(st.body)
+            for a in head.last:
+                for b in body.first:
+                    self._pair(a, b)
+            return _Seq(
+                head.first or body.first,
+                body.last if body.definite else body.last | head.last,
+                definite=head.definite or body.definite,
+                terminated=body.terminated,
+            )
+        if isinstance(st, ast.Try) or st.__class__.__name__ == "TryStar":
+            # alternatives, approximately: body(+else) or a handler,
+            # then finally. No cross-section pairing — exception edges
+            # make any emit in the body a possible predecessor of any
+            # handler emit, which would be all noise.
+            b = self.seq(list(st.body) + list(st.orelse))
+            sections = [b] + [self.seq(h.body) for h in st.handlers]
+            fin = self.seq(st.finalbody)
+            first = set().union(*(s.first for s in sections))
+            last = set().union(*(s.last for s in sections if not s.terminated))
+            for a in last:
+                for x in fin.first:
+                    self._pair(a, x)
+            if fin.definite:
+                last = fin.last
+            elif fin.first or fin.last:
+                last = last | fin.last
+            if not first and fin.first:
+                first = fin.first
+            return _Seq(first, last, definite=False,
+                        terminated=all(s.terminated for s in sections))
+        if isinstance(st, ast.Match):
+            cases = [self.seq(c.body) for c in st.cases]
+            first = set().union(*(s.first for s in cases)) if cases else set()
+            last = set().union(*(s.last for s in cases
+                                 if not s.terminated)) if cases else set()
+            return _Seq(first, last, definite=False)
+        # simple statement: chain its emits in AST order
+        return self._chain(_stmt_emits(st, self.path, self.findings,
+                                       self.in_note_def))
+
+    def _chain(self, emits) -> _Seq:
+        if not emits:
+            return _Seq()
+        for a, b in zip(emits, emits[1:]):
+            self._pair(a, b)
+        return _Seq({emits[0]}, {emits[-1]}, definite=True)
+
+
+def _check_kinds_assign(node: ast.Assign, path, findings) -> None:
+    """Any module-level ``KINDS = (...)`` tuple must be set-equal to the
+    spec: vocabulary and transition structure move together."""
+    if len(node.targets) != 1:
+        return
+    tgt = node.targets[0]
+    if not (isinstance(tgt, ast.Name) and tgt.id == "KINDS"):
+        return
+    if not isinstance(node.value, (ast.Tuple, ast.List)):
+        return
+    declared = [e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    extra = sorted(set(declared) - set(SPEC))
+    missing = sorted(set(SPEC) - set(declared))
+    if extra:
+        findings.append(Finding(
+            path, node.lineno, "protocol-kind",
+            f"KINDS declares kind(s) {extra} with no transition in the "
+            f"lifecycle spec"))
+    if missing:
+        findings.append(Finding(
+            path, node.lineno, "protocol-kind",
+            f"lifecycle spec kind(s) {missing} are missing from this "
+            f"KINDS vocabulary"))
+
+
+def run(tree: ast.AST, path: str) -> list:
+    findings: list = []
+    for st in tree.body:
+        if isinstance(st, ast.Assign):
+            _check_kinds_assign(st, path, findings)
+    # module-level emits (rare) + every function body
+    _OrderWalk(path, findings, in_note_def=False).seq([
+        st for st in tree.body
+        if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))
+    ])
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_note_def = node.name in _NOTE_NAMES
+            _OrderWalk(path, findings, in_note_def).seq(node.body)
+    return findings

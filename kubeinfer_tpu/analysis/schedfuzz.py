@@ -422,9 +422,17 @@ def _scn_flight_churn(fz: SchedFuzzer):
     fr = FlightRecorder(capacity=16, name="schedfuzz.FlightRecorder._lock")
 
     def noter(i: int) -> None:
-        kind = "submit" if i == 0 else "retire"
+        # distinct emitter vocabularies so the churn stays protocol-
+        # conformant under the live monitor: noter 0 opens fresh chains
+        # (unique rids — a duplicate submit would be an illegal
+        # new->queued transition), noter 1 hammers an engine-level kind
+        # that carries no per-request chain at all
         for k in range(6):
-            fr.note(kind, queue_depth=k)
+            if i == 0:
+                fr.note("submit", queue_depth=k, req=100 + k,
+                        prompt_tokens=8, max_new=4)
+            else:
+                fr.note("import_staged", queue_depth=k, blocks=1)
 
     def snapper() -> None:
         for _ in range(4):
@@ -500,27 +508,33 @@ def _scn_engine_multistep(fz: SchedFuzzer):
     interleaving — the exact double-buffered bookkeeping the fused
     loop added. Invariants under EVERY schedule: block refs balance
     back to zero and each request reaches exactly one terminal state
-    (served xor failed) — a schedule that loses a staged plan leaks
-    pool refs, one that double-drains serves a request twice.
+    — verified by replaying the scenario's flight ring against the
+    lifecycle spec (protocol.assert_conformant): a schedule that loses
+    a staged plan leaves an open chain, one that double-drains emits
+    after a terminal state.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     pool = BlockPool(32, 4)
     lock = make_lock("schedfuzz.engine-multistep._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-multistep.FlightRecorder._lock"
+    )
     pending: list[int] = []
     staged: list[tuple[int, list[int]]] = []
-    served: list[int] = []
-    failed: list[int] = []
     state = {"stopped": False}
 
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 # post-stop submits fail fast instead of queueing
                 # (ContinuousEngine.submit after stop())
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped at the door")
                 else:
                     pending.append(rid)
 
@@ -533,7 +547,9 @@ def _scn_engine_multistep(fz: SchedFuzzer):
                 if state["stopped"]:
                     return
                 if pending:
-                    staged.append((pending.pop(0), pool.alloc(2)))
+                    rid = pending.pop(0)
+                    fr.note("admit", req=rid, slot=0)
+                    staged.append((rid, pool.alloc(2)))
             # window boundary: drain the staged plans. Entries popped
             # here are owned by this thread — a stop landing after the
             # pop still sees them served, never swept twice
@@ -545,7 +561,7 @@ def _scn_engine_multistep(fz: SchedFuzzer):
             for rid, blocks in batch:
                 pool.unref(blocks)  # serve + retire, compressed
                 with lock:
-                    served.append(rid)
+                    fr.note("retire", req=rid, slot=0, tokens=4)
 
     def stopper() -> None:
         # a few pure yield points first so the seed decides where the
@@ -564,9 +580,11 @@ def _scn_engine_multistep(fz: SchedFuzzer):
         for rid, blocks in swept:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept staged")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] the staged sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("sched", scheduler)
@@ -574,7 +592,7 @@ def _scn_engine_multistep(fz: SchedFuzzer):
 
     def verify() -> None:
         assert not staged and not pending, (staged, pending)
-        assert sorted(served + failed) == list(range(6)), (served, failed)
+        protocol.assert_conformant(fr, expect=range(6))
         assert pool.used_blocks == 0, pool.used_blocks
         assert pool.free_blocks == 31, pool.free_blocks
     return verify
@@ -597,27 +615,31 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
     moment: occupancy can exceed 2x staged (a drain batch unrefs
     outside the lock) but never undercut it. Admission invariants are
     the multistep ones: refs balance to zero, exactly one terminal
-    state per request. Lock order stays engine->pool on every thread —
-    a scrape taking them the other way would trip the cycle oracle.
+    state per request (spec replay). Lock order stays engine->pool on
+    every thread — a scrape the other way would trip the cycle oracle.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     tp = 4
     pool = BlockPool(32, 4)
     lock = make_lock("schedfuzz.engine-sharded-window._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-sharded-window.FlightRecorder._lock"
+    )
     pending: list[int] = []
     staged: list[tuple[int, list[int]]] = []
-    served: list[int] = []
-    failed: list[int] = []
     scrapes: list[tuple] = []
     state = {"stopped": False}
 
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped at the door")
                 else:
                     pending.append(rid)
 
@@ -629,7 +651,9 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
                 if state["stopped"]:
                     return
                 if pending:
-                    staged.append((pending.pop(0), pool.alloc(2)))
+                    rid = pending.pop(0)
+                    fr.note("admit", req=rid, slot=0)
+                    staged.append((rid, pool.alloc(2)))
             # window boundary: drain the staged plans (batch owned by
             # this thread once popped)
             with lock:
@@ -640,7 +664,7 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
             for rid, blocks in batch:
                 pool.unref(blocks)
                 with lock:
-                    served.append(rid)
+                    fr.note("retire", req=rid, slot=0, tokens=4)
 
     def scraper() -> None:
         for _ in range(4):
@@ -662,9 +686,11 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
         for rid, blocks in swept:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept staged")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] staged sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("sched", scheduler)
@@ -673,7 +699,7 @@ def _scn_engine_sharded_window(fz: SchedFuzzer):
 
     def verify() -> None:
         assert not staged and not pending, (staged, pending)
-        assert sorted(served + failed) == list(range(6)), (served, failed)
+        protocol.assert_conformant(fr, expect=range(6))
         assert pool.used_blocks == 0, pool.used_blocks
         assert pool.free_blocks == 31, pool.free_blocks
         for floor, shards in scrapes:
@@ -703,25 +729,30 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
     state. A schedule that drains a parked row double-serves; one
     that loses a live row at stop leaks its verify-slack blocks.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     K = 4
     BUDGET = 6
     pool = BlockPool(32, 4)
     lock = make_lock("schedfuzz.engine-spec-rollback._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-spec-rollback.FlightRecorder._lock"
+    )
     pending: list[int] = []
     staged: list[tuple[int, list[int]]] = []
     slots: dict[int, dict] = {}
-    served: list[int] = []
-    failed: list[int] = []
+    preempted: set[int] = set()
     state = {"stopped": False, "seq": 0}
 
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped at the door")
                 else:
                     pending.append(rid)
 
@@ -741,6 +772,13 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
                 if state["stopped"]:
                     return
                 for rid, blocks in staged:
+                    # a row coming back from a park re-enters as a
+                    # resume, not a fresh admit (parked is not a legal
+                    # admit source in the lifecycle spec)
+                    if rid in preempted:
+                        fr.note("resume", req=rid, slot=0)
+                    else:
+                        fr.note("admit", req=rid, slot=0)
                     slots[rid] = {
                         "blocks": blocks, "committed": 0, "offset": 0,
                     }
@@ -771,7 +809,7 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
             for rid, blocks in drain:
                 pool.unref(blocks)
                 with lock:
-                    served.append(rid)
+                    fr.note("retire", req=rid, slot=0, tokens=BUDGET)
 
     def parker() -> None:
         for _ in range(3):
@@ -782,6 +820,8 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
                 if slots:
                     rid = next(iter(slots))
                     blocks = slots.pop(rid)["blocks"]
+                    fr.note("preempt", req=rid, slot=0)
+                    preempted.add(rid)
             if rid is None:
                 continue
             pool.unref(blocks)
@@ -790,7 +830,7 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
                 # slot — a post-stop park routes to failed like any
                 # other post-stop submit
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped while parked")
                 else:
                     pending.append(rid)
 
@@ -811,9 +851,11 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
         for rid, blocks in swept + live:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept staged/live")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] staged/live sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("sched", scheduler)
@@ -824,7 +866,7 @@ def _scn_engine_spec_rollback(fz: SchedFuzzer):
         assert not staged and not pending and not slots, (
             staged, pending, slots,
         )
-        assert sorted(served + failed) == list(range(6)), (served, failed)
+        protocol.assert_conformant(fr, expect=range(6))
         assert pool.used_blocks == 0, pool.used_blocks
         assert pool.free_blocks == 31, pool.free_blocks
     return verify
@@ -850,17 +892,21 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
     referenced, and after a full drain-eviction the pool's refs balance
     to zero.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     BS = 4
     pool = BlockPool(32, BS)
     radix = RadixCache(pool)
     lock = make_lock("schedfuzz.engine-kv-import._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-kv-import.FlightRecorder._lock"
+    )
     pending: list[int] = []
     slots: dict[int, dict] = {}
-    served: list[int] = []
-    failed: list[int] = []
+    preempted: set[int] = set()
     state = {"stopped": False}
 
     def toks(rid: int) -> list[int]:
@@ -892,6 +938,9 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
                 if blocks is None:
                     continue
                 radix.insert(toks(fam), blocks)
+                # engine-level kind: no per-request chain, so the
+                # monitor only schema-checks it
+                fr.note("import", blocks=len(blocks))
             pool.unref(blocks)
 
     def scheduler() -> None:
@@ -908,8 +957,12 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
                     extra = alloc_tagged(2 - len(matched), ("adm", rid))
                     if extra is None:
                         pool.unref(matched)
-                        failed.append(rid)
+                        fr.note("fail", req=rid, reason="kv backpressure")
                     else:
+                        if rid in preempted:
+                            fr.note("resume", req=rid, slot=0)
+                        else:
+                            fr.note("admit", req=rid, slot=0)
                         slots[rid] = {
                             "blocks": matched + extra, "sig": sig,
                         }
@@ -933,13 +986,15 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
             if drain is not None:
                 pool.unref(drain[1])
                 with lock:
-                    served.append(drain[0])
+                    # lint: allow[protocol-order] the admit-phase backpressure fail and this retire belong to DIFFERENT requests
+                    fr.note("retire", req=drain[0], slot=0, tokens=4)
 
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped at the door")
                 else:
                     pending.append(rid)
 
@@ -955,13 +1010,16 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
                     # park caches the committed blocks before the slot
                     # lets go — the warm-readmit contract
                     radix.insert(toks(rid), row["blocks"])
+                    fr.note("preempt", req=rid, slot=0)
+                    preempted.add(rid)
                     parked = (rid, row["blocks"])
             if parked is None:
                 continue
             pool.unref(parked[1])
             with lock:
                 if state["stopped"]:
-                    failed.append(parked[0])
+                    fr.note("fail", req=parked[0],
+                            reason="stopped while parked")
                 else:
                     pending.append(parked[0])
 
@@ -987,9 +1045,11 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
         for rid, blocks in live:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept live")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] live sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("import", importer)
@@ -1000,7 +1060,7 @@ def _scn_engine_kv_import(fz: SchedFuzzer):
 
     def verify() -> None:
         assert not pending and not slots, (pending, slots)
-        assert sorted(served + failed) == list(range(6)), (served, failed)
+        protocol.assert_conformant(fr, expect=range(6))
         # only the trie holds blocks now — every one is refcount 1, so
         # a full eviction pass must drain the pool to zero (a block a
         # refcount bug left pinned would make ensure_free come up short)
@@ -1031,17 +1091,21 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
     Under every schedule: one terminal state per request, no tail
     block in the trie or in an export, refs drain to zero.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     BS = 4
     pool = BlockPool(32, BS)
     radix = RadixCache(pool)
     lock = make_lock("schedfuzz.engine-quant-commit._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-quant-commit.FlightRecorder._lock"
+    )
     pending: list[int] = []
     slots: dict[int, dict] = {}
-    served: list[int] = []
-    failed: list[int] = []
+    preempted: set[int] = set()
     exports: list[int] = []
     state = {"stopped": False}
 
@@ -1087,8 +1151,12 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
                     extra = alloc_tagged(need, ("adm", rid))
                     if extra is None:
                         pool.unref(matched)
-                        failed.append(rid)
+                        fr.note("fail", req=rid, reason="kv backpressure")
                     else:
+                        if rid in preempted:
+                            fr.note("resume", req=rid, slot=0)
+                        else:
+                            fr.note("admit", req=rid, slot=0)
                         if extra:
                             qstate[extra[-1]] = "tail"
                         slots[rid] = {
@@ -1126,13 +1194,15 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
             if drain is not None:
                 pool.unref(drain[1])
                 with lock:
-                    served.append(drain[0])
+                    # lint: allow[protocol-order] the admit-phase backpressure fail and this retire belong to DIFFERENT requests
+                    fr.note("retire", req=drain[0], slot=0, tokens=4)
 
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 if state["stopped"]:
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="stopped at the door")
                 else:
                     pending.append(rid)
 
@@ -1153,13 +1223,16 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
                         else row["blocks"]
                     )
                     insert_committed(toks(rid)[: len(keep) * BS], keep)
+                    fr.note("preempt", req=rid, slot=0)
+                    preempted.add(rid)
                     parked = (rid, row["blocks"])
             if parked is None:
                 continue
             pool.unref(parked[1])
             with lock:
                 if state["stopped"]:
-                    failed.append(parked[0])
+                    fr.note("fail", req=parked[0],
+                            reason="stopped while parked")
                 else:
                     pending.append(parked[0])
 
@@ -1201,9 +1274,11 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
         for rid, blocks in live:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept live")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] live sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("sched", scheduler)
@@ -1214,7 +1289,7 @@ def _scn_engine_quant_commit(fz: SchedFuzzer):
 
     def verify() -> None:
         assert not pending and not slots, (pending, slots)
-        assert sorted(served + failed) == list(range(6)), (served, failed)
+        protocol.assert_conformant(fr, expect=range(6))
         assert radix.ensure_free(31), pool.used_blocks
         assert pool.used_blocks == 0, pool.used_blocks
         assert pool.free_blocks == 31, pool.free_blocks
@@ -1242,18 +1317,20 @@ def _scn_engine_migrate(fz: SchedFuzzer):
     tail block ships junk under a valid fingerprint; one that
     finalizes a stop-swept slot double-frees its pool refs.
     """
+    from kubeinfer_tpu.analysis import protocol
     from kubeinfer_tpu.analysis.racecheck import make_lock
     from kubeinfer_tpu.inference.kv_blocks import BlockPool, RadixCache
+    from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
     BS = 4
     pool = BlockPool(32, BS)
     radix = RadixCache(pool)
     lock = make_lock("schedfuzz.engine-migrate._lock")
+    fr = FlightRecorder(
+        capacity=256, name="schedfuzz.engine-migrate.FlightRecorder._lock"
+    )
     pending: list[int] = []
     slots: dict[int, dict] = {}
-    served: list[int] = []
-    migrated: list[int] = []
-    failed: list[int] = []
     chunks: list[tuple[int, tuple]] = []
     state = {"stopped": False, "draining": False, "seq": 0}
 
@@ -1277,10 +1354,11 @@ def _scn_engine_migrate(fz: SchedFuzzer):
     def submitter() -> None:
         for rid in range(6):
             with lock:
+                fr.note("submit", req=rid, prompt_tokens=8, max_new=4)
                 if state["stopped"] or state["draining"]:
                     # EngineDrainingError at the door: the router
                     # re-routes; terminal HERE for the oracle
-                    failed.append(rid)
+                    fr.note("fail", req=rid, reason="refused at the door")
                 else:
                     pending.append(rid)
 
@@ -1299,7 +1377,8 @@ def _scn_engine_migrate(fz: SchedFuzzer):
                     pending.clear()
                 if swept:
                     with lock:
-                        migrated.extend(swept)  # streamed=0 hand-off
+                        for rid in swept:  # streamed=0 hand-off
+                            fr.note("migrate", req=rid, blocks=0)
                     continue
                 stream = final = None
                 with lock:
@@ -1322,10 +1401,12 @@ def _scn_engine_migrate(fz: SchedFuzzer):
                         # flaky sink: fall forward — stop streaming,
                         # finalize next pass with what already went
                         with lock:
+                            fr.note("migrate_sink_error", req=rid, slot=0)
                             row["cursor"] = row["committed"]
                         continue
                     chunks.append((rid, tag))
                     with lock:
+                        fr.note("migrate_chunk", req=rid, slot=0, blocks=1)
                         row["cursor"] += 1
                 elif final is not None:
                     rid, row = final
@@ -1343,7 +1424,8 @@ def _scn_engine_migrate(fz: SchedFuzzer):
                                      row["blocks"][:n])
                     pool.unref(row["blocks"])
                     with lock:
-                        migrated.append(rid)
+                        fr.note("migrate", req=rid,
+                                blocks=row["committed"])
                 continue
             # -- normal service: admit, then retire ----------------
             with lock:
@@ -1355,8 +1437,9 @@ def _scn_engine_migrate(fz: SchedFuzzer):
                     extra = alloc_tagged(3 - len(matched), ("adm", rid))
                     if extra is None:
                         pool.unref(matched)
-                        failed.append(rid)
+                        fr.note("fail", req=rid, reason="kv backpressure")
                     else:
+                        fr.note("admit", req=rid, slot=0)
                         if extra:
                             qstate[extra[-1]] = "tail"
                         blocks = matched + extra
@@ -1384,16 +1467,20 @@ def _scn_engine_migrate(fz: SchedFuzzer):
             if drain is not None:
                 pool.unref(drain[1])
                 with lock:
-                    served.append(drain[0])
+                    # lint: allow[protocol-order] the admit-phase backpressure fail and this retire belong to DIFFERENT requests
+                    fr.note("retire", req=drain[0], slot=0, tokens=4)
 
     def drainer() -> None:
         # the seed decides where the drain lands relative to every
         # admit/retire/stream; flipping the flag is ALL this thread
-        # does — the scheduler owns the drain work, like production
+        # does — the scheduler owns the drain work, like production.
+        # drain_start shares the flag's lock hold so no migrate emit
+        # can precede it in ring-seq order (the monitor's drain guard)
         for _ in range(3):
             with lock:
                 pass
         with lock:
+            fr.note("drain_start")
             state["draining"] = True
 
     def stopper() -> None:
@@ -1409,9 +1496,11 @@ def _scn_engine_migrate(fz: SchedFuzzer):
         for rid, blocks in live:
             pool.unref(blocks)
             with lock:
-                failed.append(rid)
+                fr.note("fail", req=rid, reason="stop swept live")
         with lock:
-            failed.extend(leftover)
+            for rid in leftover:
+                # lint: allow[protocol-order] live sweep above and this pending sweep fail DISTINCT request populations
+                fr.note("fail", req=rid, reason="stop swept pending")
 
     fz.spawn("submit", submitter)
     fz.spawn("sched", scheduler)
@@ -1420,9 +1509,7 @@ def _scn_engine_migrate(fz: SchedFuzzer):
 
     def verify() -> None:
         assert not pending and not slots, (pending, slots)
-        assert sorted(served + migrated + failed) == list(range(6)), (
-            served, migrated, failed,
-        )
+        protocol.assert_conformant(fr, expect=range(6))
         # every streamed chunk carried committed content
         for _rid, tag in chunks:
             assert tag[0] in ("adm", "com", "imp"), tag
@@ -1453,15 +1540,28 @@ SCENARIOS = [
 def run_scenario(scn: Scenario, seed: int,
                  schedule: list[str] | None = None) -> SchedFuzzer:
     """One seeded (or replayed) run with fresh race-oracle state.
-    Raises on scenario exception, deadlock, verify failure, lockset
-    race, or lock-order cycle; returns the fuzzer (trace + schedule)."""
-    from kubeinfer_tpu.analysis import lockset
+    Raises on scenario exception, deadlock, verify failure, protocol
+    violation, lockset race, or lock-order cycle; returns the fuzzer
+    (trace + schedule)."""
+    from kubeinfer_tpu.analysis import lockset, protocol
+    from kubeinfer_tpu.observability import flightrecorder
 
     racecheck.REGISTRY.reset()
     lockset.REGISTRY.reset()
     fz = SchedFuzzer(seed, schedule=schedule)
     verify = scn.build(fz)
-    fz.run()
+    # live oracle: every fr.note in every scenario streams through the
+    # lifecycle monitor as it happens — a transition the ring has
+    # already evicted still gets checked. Save/restore so the chaos
+    # tier's session-wide monitor (tests/conftest.py) keeps its stream.
+    mon = protocol.ProtocolMonitor()
+    prev = flightrecorder.get_monitor()
+    flightrecorder.set_monitor(mon)
+    try:
+        fz.run()
+    finally:
+        flightrecorder.set_monitor(prev)
+    mon.assert_clean()
     verify()
     races = lockset.REGISTRY.races()
     if races:

@@ -40,6 +40,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import itertools
 import logging
 import queue
 import threading
@@ -553,11 +554,19 @@ class PreemptionPolicy:
         return cls(**kw)
 
 
+# process-wide request-id stream: every flight-recorder lifecycle emit
+# carries ``req=<rid>`` (the canonical detail key the protocol spec in
+# analysis/protocol.py requires), so a /debug/flightrecorder dump keys
+# each request's chain unambiguously even across engine restarts
+_REQ_IDS = itertools.count()
+
+
 @dataclass
 class _Request:
     prompt: list[int]
     max_new: int
     eos_id: int
+    rid: int = field(default_factory=lambda: next(_REQ_IDS))
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
@@ -1051,9 +1060,13 @@ class ContinuousEngine:
         req.trace_parent = ctx if ctx is not None else \
             tracing.new_root_context()
         req.t_submit = tracing.now()
-        self._queue.put(req)
-        self._note("submit", prompt_tokens=len(prompt),
+        # note BEFORE the queue publish: once the request is visible the
+        # scheduler thread can admit it, and an admit event with a lower
+        # ring seq than its own submit would be an illegal transition to
+        # the protocol oracle (and a lie to any post-mortem reader)
+        self._note("submit", req=req.rid, prompt_tokens=len(prompt),
                    max_new=max_new_tokens)
+        self._queue.put(req)
         return req
 
     def serve(self, prompt: list[int], max_new_tokens: int = 32,
@@ -1112,7 +1125,11 @@ class ContinuousEngine:
                 return
             self._draining = True
             self._drained.clear()
-        self._note("drain_start")
+            # note under the lock: the scheduler observes _draining via
+            # this same lock, so the drain_start event's ring seq is
+            # guaranteed to precede every migrate* emit of the window —
+            # the protocol oracle's drain guard depends on that order
+            self._note("drain_start")
 
     def undrain(self) -> None:
         """Resume admissions after a drain (rebalance / cancelled
@@ -1124,7 +1141,9 @@ class ContinuousEngine:
                 return
             self._draining = False
             self._drained.clear()
-        self._note("drain_end")
+            # under the lock for the same seq-order guarantee as
+            # drain_start: no migrate* emit may land after this event
+            self._note("drain_end")
 
     def wait_drained(self, timeout_s: float = 30.0) -> bool:
         """Block until every live session has reached a terminal state
@@ -1491,6 +1510,7 @@ class ContinuousEngine:
             except queue.Empty:
                 break
             req.failed = "engine stopped before the request was served"
+            self._note("fail", req=req.rid, reason="engine stopped")
             req.done.set()
         # the join above can expire behind a long jit compile, leaving
         # the scheduler live — and the scheduler may PUBLISH a slot or
@@ -1529,22 +1549,30 @@ class ContinuousEngine:
                 if req is not None:
                     self._slot_req[slot] = None
                     req.failed = "engine stopped mid-generation"
+                    self._note("fail", req=req.rid,
+                               reason="stopped mid-generation")
                     req.done.set()
                     failed += 1
         for holdover in held:
             holdover.failed = "engine stopped before the request was served"
+            # lint: allow[protocol-order] consecutive sweeps fail DISTINCT request populations (slots, holdover, parked, staged, group); each chain sees exactly one fail
+            self._note("fail", req=holdover.rid, reason="stopped unserved")
             holdover.done.set()
             failed += 1
         for req in parked:
             # parked requests carry partial output: fail, never return
             # a truncated token list as a normal completion
             req.failed = "engine stopped mid-generation"
+            # lint: allow[protocol-order] distinct population from the holdover sweep above
+            self._note("fail", req=req.rid, reason="stopped while parked")
             req.done.set()
             failed += 1
         for req, _slot, kv_plan, _tokens in staged:
             table_row, _own, _reuse, total, _spec = kv_plan
             self._pool.unref([int(b) for b in table_row[:total]])
             req.failed = "engine stopped before the request was served"
+            # lint: allow[protocol-order] distinct population from the parked sweep above
+            self._note("fail", req=req.rid, reason="stopped while staged")
             req.done.set()
             failed += 1
         for task in imports:
@@ -1553,6 +1581,9 @@ class ContinuousEngine:
         if group is not None:
             for req in group[0]:
                 req.failed = "engine stopped mid-generation"
+                # lint: allow[protocol-order] distinct population from the staged sweep above
+                self._note("fail", req=req.rid,
+                           reason="stopped mid spec-group")
                 req.done.set()
                 failed += 1
         if failed:
@@ -1570,7 +1601,7 @@ class ContinuousEngine:
 
     # -- scheduler loop ---------------------------------------------------
 
-    def _plan_kv(self, tokens: list[int], max_new: int):
+    def _plan_kv(self, tokens: list[int], max_new: int, rid: int = -1):
         """Host-side paged-admit plan: radix match → capacity clamp →
         evict/alloc. ``tokens`` is the EFFECTIVE prompt — the original
         prompt for a fresh admit, prompt + generated-so-far for a
@@ -1630,7 +1661,7 @@ class ContinuousEngine:
                 # shortfall is structural; the detail says which case
                 # the post-mortem is looking at (free+evictable < need
                 # = pinned by live rows)
-                self._note("backpressure", prompt_tokens=p,
+                self._note("backpressure", req=rid, prompt_tokens=p,
                            need_blocks=total - reuse,
                            free_blocks=self._pool.free_blocks,
                            evictable_blocks=self._radix.evictable_blocks(),
@@ -1753,8 +1784,8 @@ class ContinuousEngine:
             "chunk", bucket=C, live_rows=live_rows,
             live_tokens=C, padded_tokens=0, start=t0, end=t1,
         )
-        self._note("chunk", slot=task.slot, pos=task.pos,
-                   prompt_tokens=len(task.tokens))
+        self._note("chunk", req=task.req.rid, slot=task.slot,
+                   pos=task.pos, prompt_tokens=len(task.tokens))
 
     def _abort_prefill(self, task: _PrefillTask) -> None:
         """Drop a cancelled mid-chunk prefill (caller holds the lock).
@@ -1768,7 +1799,8 @@ class ContinuousEngine:
         if blocks:
             self._pool.unref(blocks)
         req.t_done = tracing.now()
-        self._note("retire", slot=slot, tokens=len(req.out_tokens),
+        self._note("retire", req=req.rid, slot=slot,
+                   tokens=len(req.out_tokens),
                    freed_blocks=len(blocks), cancelled=True)
         req.done.set()
 
@@ -1918,11 +1950,11 @@ class ContinuousEngine:
         )
         if task.resumed:
             self.resumed_total += 1
-            self._note("resume", slot=slot, suffix_bucket=T,
+            self._note("resume", req=req.rid, slot=slot, suffix_bucket=T,
                        reuse_blocks=reuse, total_blocks=total,
                        preemptions=req.preemptions)
         else:
-            self._note("admit", slot=slot, suffix_bucket=T,
+            self._note("admit", req=req.rid, slot=slot, suffix_bucket=T,
                        reuse_blocks=reuse, total_blocks=total)
         # span start: a FRESH admission's prefill phase begins at
         # t_admit — exactly where engine.queue_wait ends (the serving
@@ -1973,7 +2005,8 @@ class ContinuousEngine:
                 tables=self._state.tables.at[slot].set(0),
             )
             req.t_done = tracing.now()
-            self._note("retire", slot=slot, tokens=len(req.out_tokens),
+            self._note("retire", req=req.rid, slot=slot,
+                       tokens=len(req.out_tokens),
                        freed_blocks=len(blocks),
                        cancelled=req.cancelled.is_set())
             sp = _TRACER.start_span(
@@ -2044,7 +2077,8 @@ class ContinuousEngine:
         req.preemptions += 1
         self.preempted_total += 1
         self._parked.append(req)
-        self._note("preempt", slot=slot, tokens=len(req.out_tokens),
+        self._note("preempt", req=req.rid, slot=slot,
+                   tokens=len(req.out_tokens),
                    cached_blocks=full, parked=len(self._parked))
 
     # -- live-session migration (drain) -----------------------------------
@@ -2066,7 +2100,7 @@ class ContinuousEngine:
         }
         req.t_done = tracing.now()
         self.migrated_total += 1
-        self._note("migrate", tokens=len(req.out_tokens),
+        self._note("migrate", req=req.rid, tokens=len(req.out_tokens),
                    blocks=streamed)
         req.done.set()
 
@@ -2210,7 +2244,7 @@ class ContinuousEngine:
                 # session off with what was already streamed; the
                 # target re-prefills the rest from the last verified
                 # chunk (or from scratch), token-identical either way
-                self._note("migrate_sink_error", slot=slot,
+                self._note("migrate_sink_error", req=req.rid, slot=slot,
                            start_block=cursor)
                 self._migrate_slot(slot, req, cursor)
                 return
@@ -2218,8 +2252,8 @@ class ContinuousEngine:
                 self._migrate_cursor[slot] = cursor + n
                 self.migration_chunks_total += 1
                 self.migration_blocks_total += n
-            self._note("migrate_chunk", slot=slot, start_block=cursor,
-                       blocks=n)
+            self._note("migrate_chunk", req=req.rid, slot=slot,
+                       start_block=cursor, blocks=n)
             return
         if final is not None:
             slot, req, cursor = final
@@ -2491,7 +2525,8 @@ class ContinuousEngine:
                 if self._slot_req[slot] is None:
                     tokens = req.prompt + req.out_tokens
                     kv_plan = self._plan_kv(
-                        tokens, req.max_new - len(req.out_tokens)
+                        tokens, req.max_new - len(req.out_tokens),
+                        rid=req.rid,
                     )
                     if kv_plan is None:
                         break  # pool backpressure: hold until a retire
@@ -2611,7 +2646,8 @@ class ContinuousEngine:
             with self._lock:
                 tokens = req.prompt + req.out_tokens
                 kv_plan = self._plan_kv(
-                    tokens, req.max_new - len(req.out_tokens)
+                    tokens, req.max_new - len(req.out_tokens),
+                    rid=req.rid,
                 )
                 if kv_plan is None:
                     self._holdover.appendleft(req)

@@ -1252,8 +1252,15 @@ class InferenceServer:
             # the router treats this as "continue elsewhere", a client
             # seeing it raw knows the tokens are a prefix
             finish = "migrated"
+        # continuous-engine routes carry the engine's flight-recorder
+        # request id (_Request.rid) in the completion id, so a client
+        # report cross-references straight into a /debug/flightrecorder
+        # dump's per-request chain (detail key `req`, see
+        # analysis/protocol.py); batch-generate routes have no rid
+        rid = getattr(route_box.get("timing"), "rid", None)
         return {
-            "id": "cmpl-kubeinfer",
+            "id": "cmpl-kubeinfer" if rid is None
+            else f"cmpl-kubeinfer-{rid}",
             "object": "text_completion",
             "model": self.model_id,
             "choices": [{

@@ -18,18 +18,26 @@ bounded memory by capacity.
 from __future__ import annotations
 
 import collections
+import itertools
 from dataclasses import dataclass, field
 
 from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.observability import tracing
 
-__all__ = ["FlightEvent", "FlightRecorder"]
+__all__ = ["FlightEvent", "FlightRecorder", "set_monitor", "get_monitor"]
 
 # the decision vocabulary; note() rejects anything else so dashboards
-# and tests can enumerate the kinds
+# and tests can enumerate the kinds. The transition structure over
+# these — which kind may follow which, per request — lives in ONE
+# place: analysis/protocol.py SPEC. protolint checks the two stay
+# set-equal, so a kind added here without a declared transition (or
+# removed here while the spec still names it) fails lint.
 KINDS = (
     "submit", "admit", "retire", "evict", "backpressure", "fail_inflight",
     "preempt", "resume", "chunk",
+    # per-request terminal failure (stop()/_fail_inflight sweeps name
+    # each dropped request; "fail_inflight" stays the aggregate)
+    "fail",
     # disaggregated prefill/decode (disagg/): a remote prefix staged
     # for scatter, landed in the pool, or rejected at validation
     "import_staged", "import", "import_reject",
@@ -38,6 +46,27 @@ KINDS = (
     "drain_start", "drain_end", "migrate_chunk", "migrate",
     "migrate_sink_error",
 )
+
+# Detail-schema hook: when armed (tests/conftest.py for chaos tests,
+# schedfuzz's run_scenario), every note() on every recorder is fed to
+# the protocol monitor — under the recorder's own lock, so per-recorder
+# events arrive in seq order and the oracle never sees a reordering the
+# ring itself didn't. The monitor records violations rather than
+# raising (a raise here would kill a scheduler thread mid-handoff).
+_MONITOR = None
+
+# recorder identity for the monitor's chain keying: two engines in one
+# test must not alias request ids
+_UIDS = itertools.count()
+
+
+def set_monitor(monitor) -> None:
+    global _MONITOR
+    _MONITOR = monitor
+
+
+def get_monitor():
+    return _MONITOR
 
 
 @dataclass(frozen=True)
@@ -76,6 +105,7 @@ class FlightRecorder:
             maxlen=capacity
         )
         self._seq = 0
+        self.uid = next(_UIDS)
 
     def note(self, kind: str, queue_depth: int = 0, kv_in_use: int = -1,
              kv_free: int = -1, t: float | None = None,
@@ -90,6 +120,9 @@ class FlightRecorder:
             )
             self._seq += 1
             self._ring.append(ev)
+            mon = _MONITOR
+            if mon is not None:
+                mon.observe(self, ev)
         return ev
 
     def snapshot(self) -> list[FlightEvent]:

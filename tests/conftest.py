@@ -79,8 +79,10 @@ _COMPONENT_BY_PREFIX = (
     # pure controlplane work — runs under the same virtual CPU mesh
     (("test_chaos", "test_resilience"), "chaos"),
     # invariant linter + racecheck sentinel (kubeinfer_tpu/analysis/);
-    # the sanitizer file covers the lockset detector + schedule fuzzer
-    (("test_static_analysis", "test_concurrency_sanitizer"), "analysis"),
+    # the sanitizer file covers the lockset detector + schedule fuzzer;
+    # the protocol files cover the lifecycle spec (lint + replay oracle)
+    (("test_static_analysis", "test_concurrency_sanitizer",
+      "test_protocol"), "analysis"),
     # fleet router: scoring/summary round-trips + proxy; its chaos
     # scenario carries an explicit @pytest.mark.chaos on top
     (("test_router",), "router"),
@@ -108,6 +110,15 @@ def pytest_collection_modifyitems(config, items):
 # lock-order graph AND guard()-registered objects feed the Eraser
 # lockset detector. Teardown fails the test on either oracle — a race
 # the schedule happened not to lose is still a finding.
+#
+# ISSUE 17 adds a third oracle to the same fixture: a ProtocolMonitor
+# streams every FlightRecorder.note through the request lifecycle spec
+# (analysis/protocol.py) as it happens, so an illegal transition is a
+# failure even when the bounded ring has already evicted the evidence.
+# Legality-only at teardown: chains may legitimately end non-terminal
+# (a test that stops mid-flight without sweeping, spec-group requests
+# that never occupy a slot), so completeness is asserted only where a
+# test knows its expected request set (protocol.assert_conformant).
 
 import pytest  # noqa: E402 — after the jax mesh setup above
 
@@ -118,14 +129,22 @@ def _sanitizer_armed(request, monkeypatch):
         yield
         return
     monkeypatch.setenv("KUBEINFER_RACECHECK", "2")
-    from kubeinfer_tpu.analysis import lockset, racecheck
+    from kubeinfer_tpu.analysis import lockset, protocol, racecheck
+    from kubeinfer_tpu.observability import flightrecorder
 
     racecheck.REGISTRY.reset()
     lockset.REGISTRY.reset()
-    yield
+    mon = protocol.ProtocolMonitor()
+    prev = flightrecorder.get_monitor()
+    flightrecorder.set_monitor(mon)
+    try:
+        yield
+    finally:
+        flightrecorder.set_monitor(prev)
     cycles = racecheck.REGISTRY.cycles()
     assert not cycles, f"lock-order cycles (deadlock potential): {cycles}"
     races = lockset.REGISTRY.races()
     assert not races, (
         "lockset data races:\n" + lockset.REGISTRY.render()
     )
+    mon.assert_clean()

@@ -134,12 +134,14 @@ class TestFlightRecorder:
     def test_unknown_kind_rejected(self):
         fr = FlightRecorder(name="test.Flight.l1")
         with pytest.raises(ValueError):
+            # lint: allow[protocol-kind] the unknown-kind rejection is the behavior under test
             fr.note("reboot")
 
     def test_ring_keeps_newest(self):
         fr = FlightRecorder(capacity=3, name="test.Flight.l2")
         for i in range(7):
-            fr.note("submit", queue_depth=i, t=float(i))
+            fr.note("submit", queue_depth=i, t=float(i), req=i,
+                    prompt_tokens=1, max_new=1)
         assert len(fr) == 3
         assert [e.seq for e in fr.snapshot()] == [4, 5, 6]
         d = fr.to_dict()
@@ -149,7 +151,7 @@ class TestFlightRecorder:
     def test_render_replays_decisions_oldest_first(self):
         fr = FlightRecorder(name="test.Flight.l3")
         fr.note("backpressure", queue_depth=5, kv_in_use=30, kv_free=2,
-                t=1.5, need_blocks=4)
+                t=1.5, req=0, reason="pool", need_blocks=4)
         fr.note("evict", queue_depth=5, kv_in_use=28, kv_free=4, t=1.6,
                 nodes=2)
         lines = fr.render().splitlines()
@@ -160,8 +162,10 @@ class TestFlightRecorder:
 
     def test_counter_events_skip_unsampled_kv(self):
         fr = FlightRecorder(name="test.Flight.l4")
-        fr.note("submit", queue_depth=1, t=1.0)  # kv defaults to -1
-        fr.note("admit", queue_depth=0, kv_in_use=8, kv_free=8, t=2.0)
+        fr.note("submit", queue_depth=1, t=1.0, req=0,
+                prompt_tokens=1, max_new=1)  # kv defaults to -1
+        fr.note("admit", queue_depth=0, kv_in_use=8, kv_free=8, t=2.0,
+                req=0, slot=0)
         evs = fr.counter_events(pid=3)
         depths = [e for e in evs if e["name"] == "queue_depth"]
         kv = [e for e in evs if e["name"] == "kv_blocks"]
