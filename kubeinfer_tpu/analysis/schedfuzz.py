@@ -416,6 +416,69 @@ def _scn_router_score(fz: SchedFuzzer):
     return verify
 
 
+def _scn_router_storm(fz: SchedFuzzer):
+    """Batch assembly racing view refresh and breaker flips. Uses the
+    python engine of route_batch directly (no jit compiles under the
+    fuzzer, no untracked _StormBatcher event waits) — the snapshot
+    copy under the router lock is the thing being raced: note_routed
+    mutates fingerprint sets in place while the batch path iterates
+    its copies."""
+    from kubeinfer_tpu.inference.kv_blocks import prefix_fingerprints
+    from kubeinfer_tpu.router.core import FleetRouter
+
+    r = FleetRouter()
+    toks = list(range(32))
+    for i in range(3):
+        r.add_replica(f"r{i}", f"http://r{i}")
+        r.update_replica(f"r{i}", {
+            "queue_depth": i, "n_slots": 2,
+            "cache_summary": {
+                "fingerprints": prefix_fingerprints(toks, 4),
+                "version": 1, "block_size": 4,
+            },
+        })
+
+    def storm_caller() -> None:
+        names = {"r0", "r1", "r2"}
+        for _ in range(3):
+            for d in r.route_batch([toks, toks[:8]], engine="python"):
+                assert d is None or d.replica in names, d
+
+    def refresher(i: int) -> None:
+        for k in range(4):
+            r.update_replica(f"r{i}", {
+                "queue_depth": k, "n_slots": 2,
+                "draining": bool(k % 2),
+                "cache_summary": {
+                    "fingerprints": prefix_fingerprints(
+                        list(range(k, k + 16)), 4
+                    ),
+                    "version": k, "block_size": 4,
+                },
+            })
+            if i == 0:
+                try:
+                    d = r.route(toks)
+                except Exception:
+                    continue  # whole fleet momentarily gated — fine
+                r.note_routed(d, list(range(100 * k, 100 * k + 24)))
+
+    def breaker_flipper() -> None:
+        view = r.replicas()[2]
+        for _ in range(3):
+            view.breaker.record_failure()
+        view.breaker.record_success()
+
+    fz.spawn("storm", storm_caller)
+    fz.spawn("refresh-0", refresher, 0)
+    fz.spawn("refresh-1", refresher, 1)
+    fz.spawn("breaker", breaker_flipper)
+
+    def verify() -> None:
+        assert len(r.replicas()) == 3
+    return verify
+
+
 def _scn_flight_churn(fz: SchedFuzzer):
     from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 
@@ -1534,6 +1597,7 @@ SCENARIOS = [
     Scenario("engine-kv-import", _scn_engine_kv_import),
     Scenario("engine-quant-commit", _scn_engine_quant_commit),
     Scenario("engine-migrate", _scn_engine_migrate),
+    Scenario("router-storm", _scn_router_storm),
 ]
 
 

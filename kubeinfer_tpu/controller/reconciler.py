@@ -333,26 +333,44 @@ class Controller:
             # batch references gives no affinity signal, and registering it
             # would burn table slots needed by later job models.
             #
-            # Queue-pressure gate (ROADMAP item 4): placement and the
-            # fleet router optimize the same objective — prefix/cache
-            # affinity minus queue pressure (router/scoring.py). The
-            # solver's affinity channel is a bitmap, so the router's
-            # continuous score quantizes here to "affine unless
-            # drowning": a node whose serving replica reports a queue
-            # at least PRESSURE_AFFINITY_CUTOFF queues-per-slot deep
-            # loses its cache pull and stops attracting MORE replicas
-            # exactly when the router would stop sending it requests.
+            # Queue-pressure affinity (ROADMAP item 4, solver-routed):
+            # placement and the fleet router optimize the same
+            # objective — prefix/cache affinity minus queue pressure
+            # (router/scoring.py). Formerly a binary gate ("affine
+            # unless the queue is PRESSURE_AFFINITY_CUTOFF deep");
+            # now each job row runs as a pseudo-request through the
+            # SAME batched route solve the router uses
+            # (solver/routing.solved_affinity), so the bitmap holds
+            # real solved assignments: the cutoff becomes relative (a
+            # drowning caching node keeps its pull against
+            # alternatives within CUTOFF of its own pressure) and the
+            # greedy feedback spreads pulls across caching nodes
+            # instead of piling every job's affinity onto one.
             # Capacity/feasibility is untouched — a drowning node can
             # still be chosen when nothing else fits.
-            cached = np.zeros((len(nodes), MAX_MODELS), np.uint8)
+            base_cached = np.zeros((len(nodes), MAX_MODELS), np.uint8)
             for i, n in enumerate(nodes):
-                pressure = scoring.queue_pressure(n.serving_stats)
-                if pressure >= scoring.PRESSURE_AFFINITY_CUTOFF:
-                    continue
                 for m in n.cached_models:
                     s = model_table.get(m)
                     if s:
-                        cached[i, s] = 1
+                        base_cached[i, s] = 1
+            from kubeinfer_tpu.solver import routing as solver_routing
+
+            cached = solver_routing.solved_affinity(
+                np.array(model, np.int32),
+                base_cached,
+                np.array(
+                    [scoring.queue_pressure(n.serving_stats)
+                     for n in nodes], np.float32,
+                ),
+                np.array(
+                    [float((n.serving_stats or {}).get("n_slots") or 1)
+                     if isinstance(n.serving_stats, dict) else 1.0
+                     for n in nodes], np.float32,
+                ),
+                alpha=scoring.ALPHA_QUEUE_BLOCKS,
+                cutoff=scoring.PRESSURE_AFFINITY_CUTOFF,
+            )
 
             req = SolveRequest(
                 job_gpu=np.array(gpu, np.float32),

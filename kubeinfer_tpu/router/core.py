@@ -21,12 +21,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
+import numpy as np
+
 from kubeinfer_tpu.analysis.racecheck import guard, make_lock
 from kubeinfer_tpu.inference.kv_blocks import (
     SUMMARY_FINGERPRINT_BUDGET,
     prefix_fingerprints,
 )
-from kubeinfer_tpu.metrics.registry import Counter, Gauge, Registry
+from kubeinfer_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
 from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.resilience import CircuitBreaker, faultpoints
 from kubeinfer_tpu.router import scoring
@@ -41,6 +43,24 @@ _OPTIMISTIC_CAP = 4 * SUMMARY_FINGERPRINT_BUDGET
 
 class NoReplicaError(RuntimeError):
     """Every known replica is dead, breaker-open, or excluded."""
+
+
+_SOLVER_OK: bool | None = None
+
+
+def _solver_importable() -> bool:
+    """Whether the jax-backed route solver can load. Cached: the
+    engine=auto check sits on the storm hot path, and a missing jax
+    raises the same ImportError every time."""
+    global _SOLVER_OK
+    if _SOLVER_OK is None:
+        try:
+            from kubeinfer_tpu.solver import routing  # noqa: F401
+
+            _SOLVER_OK = True
+        except Exception:
+            _SOLVER_OK = False
+    return _SOLVER_OK
 
 
 def _router_metrics(registry: Registry) -> dict:
@@ -124,6 +144,36 @@ def _router_metrics(registry: Registry) -> dict:
             "(no_target = every other replica dead/draining; hop_limit "
             "= rolling drains exceeded the per-request resume budget)",
             labels=("reason",), registry=registry,
+        ),
+        # batched route solve (storm mode): whole arrival batches
+        # assigned in one solver dispatch instead of N Python scans
+        "solve_seconds": Histogram(
+            "kubeinfer_router_solve_seconds",
+            "Batched route-solve latency, snapshot to assignments "
+            "(plane build + solve + decision decode)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0, 5.0),
+            registry=registry,
+        ),
+        "batch_size": Gauge(
+            "kubeinfer_router_batch_size",
+            "Requests assigned by the most recent batched route solve",
+            registry=registry,
+        ),
+        "solver_routed": Counter(
+            "kubeinfer_router_solver_routed_total",
+            "Requests routed through the batched solve, by mode "
+            "(parity/greedy/auction = solver engine; python = the "
+            "per-request scorer run in batch form)",
+            labels=("mode",), registry=registry,
+        ),
+        # tokenizer satellite: string prompts that could not be
+        # tokenized route as counted least-loaded fallbacks
+        "tokenizer_fallback": Counter(
+            "kubeinfer_router_tokenizer_fallback_total",
+            "String prompts routed without token ids (no tokenizer "
+            "configured, or encode failed)",
+            registry=registry,
         ),
     }
 
@@ -457,6 +507,232 @@ class FleetRouter:
             self.metrics["routed"].inc(decision.replica, "affinity")
         self.metrics["affinity_ratio"].set(ratio)
         return decision
+
+    # -- the batched decision (storm mode) ----------------------------------
+
+    def route_batch(
+        self,
+        token_batch: Sequence[Sequence[int]],
+        excludes: Sequence[frozenset | set] | None = None,
+        *,
+        engine: str = "auto",
+        mode: str = "parity",
+        accel: str = "auto",
+    ) -> list[RouteDecision | None]:
+        """Assign a whole arrival batch in one solve.
+
+        Returns one ``RouteDecision`` per request (None = no routable
+        replica — callers fall back to ``route`` for its NoReplicaError
+        message). All requests share ONE view snapshot, taken under the
+        lock; the solve itself runs outside it (the jit dispatch must
+        never sit under the router lock).
+
+        ``engine``: ``solver`` builds the [B, R] cost planes and solves
+        on device (solver/routing.py); ``python`` runs the per-request
+        scorer over the same snapshot (the no-jax fallback, the
+        schedfuzz path, and the equivalence oracle — parity semantics
+        only); ``auto`` prefers the solver. ``mode`` is the solver's
+        solve mode (parity/greedy/auction); decisions are rebuilt
+        host-side from the chosen replica with the same float64 scoring
+        as ``route``, so the B=1 parity case is byte-compatible with
+        the single-request path under the documented tie-break (replica
+        axis name-sorted; f32 solve score vs float64 scorer can differ
+        only within f32 rounding of near-ties). ``accel`` forwards to
+        ``solve_routes`` (auto/jnp/pallas/interpret — bench pins jnp to
+        keep the solve off the relay-attached device).
+        """
+        nb = len(token_batch)
+        if nb == 0:
+            return []
+        if excludes is None:
+            excludes = [frozenset()] * nb
+        faultpoints.fire("router.route_batch")
+        with _TRACER.span("router.route_batch") as span:
+            t0 = time.perf_counter()
+            now = self._clock()
+            with self._lock:
+                # fingerprint sets are mutated in place by note_routed;
+                # the per-request scorer only does membership tests, but
+                # the plane builder iterates — copy under the lock
+                snap = sorted(
+                    (
+                        (v.name, v.url, frozenset(v.fingerprints),
+                         v.block_size, v.serving, v.last_seen, v.breaker)
+                        for v in self._replicas.values()
+                    ),
+                    key=lambda s: s[0],
+                )
+            n_views = len(snap)
+            counts = {"alive": 0, "stale": 0, "dead": 0, "draining": 0}
+            col_ok = np.zeros(n_views, bool)
+            col_stale = np.zeros(n_views, bool)
+            pressures = [0.0] * n_views
+            slots = np.ones(n_views, np.float32)
+            headroom = np.ones(n_views, np.float32)
+            name_col = {s[0]: r for r, s in enumerate(snap)}
+            excl_counts = [0] * n_views
+            for ex in excludes:
+                for nm in ex:
+                    r = name_col.get(nm)
+                    if r is not None:
+                        excl_counts[r] += 1
+            for r, (name, _url, _fps, _bs, serving, last_seen,
+                    breaker) in enumerate(snap):
+                if excl_counts[r]:
+                    self.metrics["skipped"].inc(
+                        name, "failed", by=excl_counts[r]
+                    )
+                rest = nb - excl_counts[r]
+                age = now - last_seen
+                if age > self.dead_after_s:
+                    counts["dead"] += 1
+                    if rest:
+                        self.metrics["skipped"].inc(name, "dead", by=rest)
+                    continue
+                # peek, never allow(): same half-open-probe rule as the
+                # per-request scorer
+                if breaker is not None and not breaker.peek():
+                    if rest:
+                        self.metrics["skipped"].inc(name, "breaker", by=rest)
+                    continue
+                if serving.get("draining"):
+                    counts["draining"] += 1
+                    if rest:
+                        self.metrics["skipped"].inc(name, "draining", by=rest)
+                    continue
+                stale = age > self.stale_after_s
+                counts["stale" if stale else "alive"] += 1
+                col_ok[r] = True
+                col_stale[r] = stale
+                pressures[r] = scoring.queue_pressure(serving)
+                slots[r] = float(serving.get("n_slots") or 1) \
+                    if isinstance(serving, dict) else 1.0
+                headroom[r] = scoring.kv_headroom(serving)
+            eligible = np.broadcast_to(col_ok, (nb, n_views)).copy()
+            for b, ex in enumerate(excludes):
+                for nm in ex:
+                    r = name_col.get(nm)
+                    if r is not None:
+                        eligible[b, r] = False
+            candidates = eligible.sum(axis=1, dtype=np.int32)
+            if engine == "auto":
+                engine = "solver" if _solver_importable() else "python"
+            if engine == "solver":
+                from kubeinfer_tpu.solver import routing as _routing
+
+                match = _routing.build_match_plane(
+                    token_batch,
+                    [s[2] for s in snap],
+                    [s[3] for s in snap],
+                )
+                rp, _, _ = _routing.pack_route_arrays(
+                    np.where(eligible, match, -1).astype(np.int32),
+                    np.asarray(pressures, np.float32),
+                    col_stale, slots, headroom,
+                )
+                picks = _routing.decode_routes(
+                    _routing.solve_routes(
+                        rp, alpha=float(self.alpha), mode=mode,
+                        accel=accel,
+                    ),
+                    nb,
+                )
+            elif engine == "python":
+                match, picks = self._batch_python_pick(
+                    token_batch, snap, eligible, col_stale, pressures
+                )
+            else:
+                raise ValueError(f"unknown route engine {engine!r}")
+
+            decisions: list[RouteDecision | None] = []
+            hits = 0
+            # per-(replica, reason) counter deltas batched into one inc
+            # each — at B=256 per-decision inc calls are a measurable
+            # slice of the chunk budget
+            routed_by: dict[tuple[str, str], int] = {}
+            for b in range(nb):
+                r = int(picks[b])
+                if r < 0:
+                    decisions.append(None)
+                    continue
+                name, url, _fps, bs, _serving, _ls, _brk = snap[r]
+                m = int(match[b, r])
+                stale = bool(col_stale[r])
+                score = scoring.replica_score(
+                    m, pressures[r], stale, alpha=self.alpha
+                )
+                fallback = m == 0
+                decisions.append(RouteDecision(
+                    replica=name, url=url, match_blocks=m,
+                    match_tokens=m * bs, pressure=pressures[r],
+                    score=score, stale=stale, fallback=fallback,
+                    candidates=int(candidates[b]),
+                ))
+                if fallback:
+                    key = (name, "fallback")
+                else:
+                    hits += 1
+                    key = (name, "affinity")
+                routed_by[key] = routed_by.get(key, 0) + 1
+            routed = sum(1 for d in decisions if d is not None)
+            if routed - hits:
+                self.metrics["affinity_misses"].inc(by=routed - hits)
+            if hits:
+                self.metrics["affinity_hits"].inc(by=hits)
+            for (name, reason), cnt in routed_by.items():
+                self.metrics["routed"].inc(name, reason, by=cnt)
+            for state, n in counts.items():
+                self.metrics["replicas"].set(state, n)
+            with self._lock:
+                self._decisions += routed
+                self._hits += hits
+                ratio = (
+                    self._hits / self._decisions if self._decisions else 0.0
+                )
+            self.metrics["affinity_ratio"].set(ratio)
+            self.metrics["solve_seconds"].observe(time.perf_counter() - t0)
+            self.metrics["batch_size"].set(nb)
+            self.metrics["solver_routed"].inc(
+                mode if engine == "solver" else "python", by=nb
+            )
+            span.set(batch=nb, engine=engine, mode=mode,
+                     routed=routed, replicas=n_views)
+            return decisions
+
+    def _batch_python_pick(
+        self,
+        token_batch: Sequence[Sequence[int]],
+        snap: list[tuple],
+        eligible: np.ndarray,
+        col_stale: np.ndarray,
+        pressures: list[float],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The per-request scorer run over a shared snapshot: returns
+        the (match plane, picks) pair the solver engine would — same
+        gates, same (score desc, name asc) tie-break, float64 math."""
+        nb, n_views = eligible.shape
+        match = np.zeros((nb, n_views), np.int32)
+        picks = np.full(nb, -1, np.int32)
+        for b, tokens in enumerate(token_batch):
+            fps_by_bs: dict[int, list[int]] = {}
+            best: tuple[float, str] | None = None
+            for r in range(n_views):
+                if not eligible[b, r]:
+                    continue
+                name, _url, fps, bs, *_rest = snap[r]
+                if bs and bs not in fps_by_bs:
+                    fps_by_bs[bs] = prefix_fingerprints(tokens, bs)
+                m = scoring.match_depth(fps_by_bs[bs], fps) if bs else 0
+                match[b, r] = m
+                score = scoring.replica_score(
+                    m, pressures[r], bool(col_stale[r]), alpha=self.alpha
+                )
+                if best is None or score > best[0] or (
+                    score == best[0] and name < best[1]
+                ):
+                    best = (score, name)
+                    picks[b] = r
+        return match, picks
 
     @property
     def affinity_hit_rate(self) -> float:

@@ -44,10 +44,14 @@ DEAD_AFTER_S = 30.0
 # prompt starts occupying multiple interleave rounds.
 DEFAULT_PREFILL_THRESHOLD_TOKENS = 256
 
-# Reconciler gate: a node whose serving replica is at least this many
-# queues-per-slot deep loses its cache-affinity pull in the placement
-# cost tensor (the solver's affinity channel is a bitmap, so the
-# continuous router score quantizes to "affine unless drowning" there).
+# Reconciler affinity scale: a caching node's pseudo-request match
+# depth in the reconciler's route solve is CUTOFF * ALPHA blocks — the
+# depth whose score goes negative exactly when queue pressure reaches
+# the cutoff. Formerly a binary gate ("affine unless drowning"); now
+# the same threshold expressed inside the batched route solve
+# (solver/routing.solved_affinity), which makes it relative: a
+# drowning caching node keeps its pull against alternatives within
+# CUTOFF of its own pressure instead of going cache-blind absolutely.
 PRESSURE_AFFINITY_CUTOFF = 1.0
 
 
@@ -64,6 +68,27 @@ def queue_pressure(serving: dict | None) -> float:
     except (TypeError, ValueError):
         return 0.0
     return max(0.0, depth) / max(1.0, slots)
+
+
+def kv_headroom(serving: dict | None) -> float:
+    """Free fraction of the replica's paged-KV pool, from the same
+    servingStats dict (``kv_blocks_free`` / ``kv_blocks_in_use``,
+    advertised since the pool gauges went real). Missing stats read as
+    full headroom — like queue_pressure, an empty signal must not repel
+    traffic. Feeds the route solve's optional gamma plane; the
+    per-request scorer below deliberately ignores it (byte-compatible
+    single-request behavior)."""
+    if not isinstance(serving, dict):
+        return 1.0
+    try:
+        free = float(serving.get("kv_blocks_free", 0))
+        used = float(serving.get("kv_blocks_in_use", 0))
+    except (TypeError, ValueError):
+        return 1.0
+    total = free + used
+    if total <= 0:
+        return 1.0
+    return max(0.0, free) / total
 
 
 def match_depth(prefix_fps: Sequence[int], advertised: frozenset | set) -> int:

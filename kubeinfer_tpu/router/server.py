@@ -42,13 +42,19 @@ import json
 import logging
 import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
 
+from kubeinfer_tpu.analysis.racecheck import make_lock
 from kubeinfer_tpu.observability import tracing
 from kubeinfer_tpu.resilience import RetryPolicy, connect_failure, faultpoints
-from kubeinfer_tpu.router.core import FleetRouter, NoReplicaError
+from kubeinfer_tpu.router.core import (
+    FleetRouter,
+    NoReplicaError,
+    RouteDecision,
+)
 from kubeinfer_tpu.utils.httpbase import BaseEndpointHandler, inject_traceparent
 
 log = logging.getLogger(__name__)
@@ -74,6 +80,75 @@ _PROXY_POLICY = RetryPolicy(
 _MAX_MIGRATION_HOPS = 3
 
 
+class _StormEntry:
+    """One queued request in a storm batch."""
+
+    __slots__ = ("tokens", "exclude", "done", "decision")
+
+    def __init__(self, tokens, exclude) -> None:
+        self.tokens = tokens
+        self.exclude = exclude
+        self.done = threading.Event()
+        self.decision: RouteDecision | None = None
+
+
+class _StormBatcher:
+    """Micro-batching admission: requests arriving within the window
+    (or while a batched solve is in flight) queue and get assigned by
+    ONE ``FleetRouter.route_batch`` call instead of N sequential scans.
+
+    Leader election is arrival-order: the request that finds no leader
+    becomes one, sleeps out the window while followers append, then
+    drains the queue and solves. The leader flag drops BEFORE the solve
+    runs — arrivals during a solve elect the next leader immediately,
+    so solve latency pipelines with the next window instead of
+    serializing behind it. Followers wait on their entry's event with a
+    generous timeout; on timeout (leader thread killed mid-solve) the
+    caller falls back to the single-request path, so the batcher can
+    delay a request but never strand one.
+    """
+
+    def __init__(self, router: FleetRouter, window_s: float,
+                 mode: str = "parity") -> None:
+        self.router = router
+        self.window_s = window_s
+        self.mode = mode
+        self._lock = make_lock("router._StormBatcher._lock")
+        self._pending: list[_StormEntry] = []
+        self._leader = False
+
+    def route(self, tokens, exclude) -> RouteDecision | None:
+        entry = _StormEntry(tokens, frozenset(exclude))
+        with self._lock:
+            self._pending.append(entry)
+            lead = not self._leader
+            if lead:
+                self._leader = True
+        if lead:
+            time.sleep(self.window_s)
+            with self._lock:
+                batch = self._pending
+                self._pending = []
+                self._leader = False
+            decisions = self.router.route_batch(
+                [e.tokens for e in batch],
+                [e.exclude for e in batch],
+                mode=self.mode,
+            )
+            for e, d in zip(batch, decisions):
+                e.decision = d
+                e.done.set()
+            return entry.decision
+        if entry.done.wait(timeout=self.window_s * 10 + 5.0):
+            return entry.decision
+        # orphaned follower: pull the entry back so a late leader
+        # drain can't double-assign it, then let the caller fall back
+        with self._lock:
+            if entry in self._pending:
+                self._pending.remove(entry)
+        return None
+
+
 class RouterServer:
     """Fleet front door over a FleetRouter."""
 
@@ -81,12 +156,26 @@ class RouterServer:
                  port: int = 0, poll_interval_s: float = 2.0,
                  upstream_timeout_s: float = 300.0,
                  prefill_threshold: int | None = None,
-                 rng: random.Random | None = None) -> None:
+                 rng: random.Random | None = None,
+                 tokenizer=None,
+                 storm_window_s: float = 0.0,
+                 storm_mode: str = "parity") -> None:
         from kubeinfer_tpu.router import scoring
 
         self.router = router
         self.poll_interval_s = poll_interval_s
         self.upstream_timeout_s = upstream_timeout_s
+        # optional, duck-typed (anything with .encode(str) -> ids):
+        # lets string prompts fingerprint-match instead of degrading to
+        # least-loaded. None keeps the router model-asset-free.
+        self.tokenizer = tokenizer
+        # storm mode: micro-batch the first placement of concurrent
+        # arrivals through one route_batch solve. 0 = off (every
+        # request takes the single-request path)
+        self._storm = (
+            _StormBatcher(router, storm_window_s, storm_mode)
+            if storm_window_s > 0 else None
+        )
         # disaggregated prefill cutoff: prompts at least this long take
         # the two-phase route when prefill replicas are registered
         self.prefill_threshold = (
@@ -161,13 +250,17 @@ class RouterServer:
                 "message": "request body is not JSON",
                 "type": "invalid_request_error"}}).encode()
         prompt = body.get("prompt")
-        # only token-id prompts are scorable (the router has no
-        # tokenizer — by design, it must not need model assets); string
-        # prompts still route, degrading to least-loaded
+        # token-id prompts are scorable as-is; string prompts go
+        # through the optional tokenizer so they fingerprint-match too
+        # (and feed the same optimistic note_routed update below) —
+        # without one they still route, degrading to counted
+        # least-loaded fallbacks
         tokens = (
             prompt if isinstance(prompt, list)
             and all(isinstance(t, int) for t in prompt) else []
         )
+        if not tokens and isinstance(prompt, str) and prompt:
+            tokens = self._encode_prompt(prompt)
         # disaggregated two-phase route: long prompts prefill on a
         # prefill-role replica first (max_tokens=0 — the replica
         # exports the KV blocks by content address), then the decode
@@ -190,25 +283,37 @@ class RouterServer:
                 raw_body = json.dumps(body).encode()
         tried: set[str] = set()
         hops = 0
+        first = True
         parked: tuple[bytes, object] | None = None
         while True:
-            try:
-                decision = self.router.route(tokens, exclude=tried)
-            except NoReplicaError as e:
-                if parked is not None:
-                    # the resume has nowhere to go (every peer dead,
-                    # draining, or failed): relay the source's partial
-                    # verbatim — finish_reason="migrated" with the
-                    # tokens-so-far intact, so the client holds
-                    # everything generated and nothing is lost
-                    self.router.metrics["migration_fallbacks"].inc(
-                        "no_target"
-                    )
-                    return 200, self._annotate(
-                        parked[0], parked[1], hops
-                    )
-                return 502, json.dumps({"error": {
-                    "message": str(e), "type": "no_replica"}}).encode()
+            decision = None
+            # storm admission covers only the FIRST placement: retries
+            # and migration resumes already hold per-request exclusion
+            # state that a shared batch would smear across requests,
+            # and they are rare enough that batching buys nothing
+            if self._storm is not None and first and not tried:
+                decision = self._storm.route(tokens, tried)
+            first = False
+            if decision is None:
+                try:
+                    decision = self.router.route(tokens, exclude=tried)
+                except NoReplicaError as e:
+                    if parked is not None:
+                        # the resume has nowhere to go (every peer
+                        # dead, draining, or failed): relay the
+                        # source's partial verbatim —
+                        # finish_reason="migrated" with the
+                        # tokens-so-far intact, so the client holds
+                        # everything generated and nothing is lost
+                        self.router.metrics["migration_fallbacks"].inc(
+                            "no_target"
+                        )
+                        return 200, self._annotate(
+                            parked[0], parked[1], hops
+                        )
+                    return 502, json.dumps({"error": {
+                        "message": str(e),
+                        "type": "no_replica"}}).encode()
             try:
                 payload = self._proxy(decision, raw_body)
             except urllib.error.HTTPError as e:
@@ -267,6 +372,25 @@ class RouterServer:
                 tried = {decision.replica}
                 continue
             return 200, self._annotate(payload, decision, hops)
+
+    def _encode_prompt(self, prompt: str) -> list[int]:
+        """Resolve a string prompt to token ids for scoring. Encoding
+        never fails the request — the ids exist only to fingerprint;
+        the replica re-tokenizes the prompt string itself — so any
+        miss (no tokenizer, encode error, exotic return type) counts
+        the fallback and routes least-loaded like before."""
+        if self.tokenizer is not None:
+            try:
+                ids = self.tokenizer.encode(prompt)
+                if isinstance(ids, list) and all(
+                    isinstance(t, int) for t in ids
+                ):
+                    return ids
+            except Exception as e:  # noqa: BLE001 — score-path only
+                log.warning("tokenizer encode failed (%s); "
+                            "least-loaded fallback", type(e).__name__)
+        self.router.metrics["tokenizer_fallback"].inc()
+        return []
 
     @staticmethod
     def _is_drain_verdict(err_body: bytes) -> bool:
@@ -503,6 +627,20 @@ class RouterServer:
         self._httpd.server_close()
 
 
+def _load_tokenizer(model_dir: str):
+    """Same lazy path the inference server uses: transformers is an
+    optional dep, and a router without it keeps working in id-only
+    mode (string prompts route least-loaded, counted)."""
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_dir)
+    except Exception as e:
+        log.warning("no tokenizer loaded from %s (%s); id-only mode",
+                    model_dir, e)
+        return None
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="kubeinfer-router")
     p.add_argument("--replica", action="append", default=[],
@@ -526,6 +664,19 @@ def main(argv: list[str] | None = None) -> int:
                    "(default: scoring.ALPHA_QUEUE_BLOCKS)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="seconds between /cache/summary refreshes")
+    p.add_argument("--tokenizer", default=None, metavar="DIR",
+                   help="tokenizer files (HF layout) so string prompts "
+                        "fingerprint-match; absent or unloadable = "
+                        "id-only mode with counted fallbacks")
+    p.add_argument("--storm-window-ms", type=float, default=0.0,
+                   help="micro-batching window: concurrent arrivals "
+                        "within it are assigned by one batched route "
+                        "solve (0 = off)")
+    p.add_argument("--storm-mode", default="parity",
+                   choices=("parity", "greedy", "auction"),
+                   help="batched solve mode: parity = per-request "
+                        "argmax semantics; greedy/auction spread the "
+                        "batch across replicas")
     args = p.parse_args(argv)
 
     from kubeinfer_tpu.router import scoring
@@ -546,7 +697,11 @@ def main(argv: list[str] | None = None) -> int:
         router.add_prefill_replica(name, url)
     srv = RouterServer(router, host=args.host, port=args.port,
                        poll_interval_s=args.poll_interval,
-                       prefill_threshold=args.prefill_threshold)
+                       prefill_threshold=args.prefill_threshold,
+                       tokenizer=(_load_tokenizer(args.tokenizer)
+                                  if args.tokenizer else None),
+                       storm_window_s=args.storm_window_ms / 1000.0,
+                       storm_mode=args.storm_mode)
     srv.poll_once()
     srv.start()
     log.info("router listening on :%d over %d decode + %d prefill "

@@ -26,6 +26,11 @@ from kubeinfer_tpu.solver.core import (
     solve_auction,
     solve_greedy,
 )
+from kubeinfer_tpu.solver.routing import (
+    RouteAssignment,
+    RouteProblem,
+    solve_routes,
+)
 
 __all__ = [
     "BUCKETS",
@@ -34,10 +39,13 @@ __all__ = [
     "JobSet",
     "NodeSet",
     "Problem",
+    "RouteAssignment",
+    "RouteProblem",
     "ScoreWeights",
     "bucket_size",
     "encode_problem",
     "solve",
     "solve_auction",
     "solve_greedy",
+    "solve_routes",
 ]

@@ -1322,3 +1322,123 @@ def auction_solve(
         valid.astype(jnp.int32).reshape(J, 1),
     )
     return asg[:, 0], iters[0, 0]
+
+
+# --- Batched request routing: masked score row-argmax (router tier) ---------
+#
+# The fleet router's batched route solve (solver/routing.py) reduces to one
+# primitive repeated every round: for each request row, the argmax over
+# replicas of ``match_depth + per-replica bias`` under a hard eligibility
+# mask, ties broken by the LOWEST replica index (the replica axis arrives
+# name-sorted, so lowest index == lowest name — the router's documented
+# tie-break). Under XLA the [B, R] score broadcast materializes per round;
+# here it lives only in VMEM tiles, same rationale as the bid kernel above.
+#
+# Parity contract: the kernel and ``route_pick_jnp`` are bit-identical BY
+# ARGUMENT, not by shared closure — the only arithmetic is one f32 add
+# (match + bias, identical op in both); everything else is comparisons.
+# A lexicographic max on (value, -index) is order-associative, so the
+# kernel's sequential tile reduction (strict ``>`` keeps the earlier
+# tile on equal values; within a tile the first index of the tile max
+# wins) selects exactly the first index of the global row max — which is
+# what the twin computes directly. tests/test_router_solver.py holds the
+# bit-identity under interpret mode.
+
+# Finite "-inf" for masked entries: Mosaic reductions over true -inf are
+# fine, but a finite sentinel keeps the "no eligible replica" row exactly
+# representable and comparable on both paths. Any real score is
+# match + bias >= -(alpha * pressure_clip + stale + gamma) >> this.
+ROUTE_NEG = -3e38
+
+
+def _route_pick_kernel(
+    match_ref,  # [TB, TR] i32 match depth in blocks; -1 = ineligible
+    bias_ref,  # [1, TR] f32 per-replica bias (pressure/stale/price folded)
+    active_ref,  # [TB, 1] i32 1 = row still unassigned this round
+    val_ref,  # [TB, 1] f32 out: running row max
+    idx_ref,  # [TB, 1] i32 out: running argmax (global replica index)
+):
+    tr = pl.program_id(1)
+    neg = jnp.float32(ROUTE_NEG)
+
+    @pl.when(tr == 0)
+    def _init():
+        val_ref[:] = jnp.full_like(val_ref, neg)
+        idx_ref[:] = jnp.full_like(idx_ref, -1)
+
+    ok = (match_ref[:] >= 0) & (active_ref[:] != 0)
+    s = jnp.where(ok, match_ref[:].astype(jnp.float32) + bias_ref[:], neg)
+    part_v = jnp.max(s, axis=1, keepdims=True)
+    r_iota = (
+        jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        + tr * s.shape[1]
+    )
+    part_i = jnp.min(
+        jnp.where(s == part_v, r_iota, jnp.int32(_I32MAX)),
+        axis=1, keepdims=True,
+    )
+    # strict >: an equal value in a LATER tile must not displace the
+    # earlier (lower-index) holder. An all-masked tile has part_v == neg
+    # and can never beat the init value, so idx stays -1 for dead rows.
+    better = part_v > val_ref[:]
+    idx_ref[:] = jnp.where(better, part_i, idx_ref[:])
+    val_ref[:] = jnp.where(better, part_v, val_ref[:])
+
+
+def route_pick_jnp(
+    match: jax.Array,  # i32[B, R]; -1 = ineligible
+    bias: jax.Array,  # f32[R]
+    active: jax.Array,  # bool[B]
+) -> tuple[jax.Array, jax.Array]:
+    """jnp twin of ``route_pick_pallas``: (row max f32[B], first-index
+    argmax i32[B], -1 when the row has no eligible replica)."""
+    B, R = match.shape
+    neg = jnp.float32(ROUTE_NEG)
+    ok = (match >= 0) & active[:, None]
+    s = jnp.where(ok, match.astype(jnp.float32) + bias[None, :], neg)
+    v = jnp.max(s, axis=1)
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (B, R), 1)
+    idx = jnp.min(
+        jnp.where(s == v[:, None], r_iota, jnp.int32(_I32MAX)), axis=1
+    )
+    idx = jnp.where(v > neg, idx, -1).astype(jnp.int32)
+    return v, idx
+
+
+def route_pick_pallas(
+    match: jax.Array,  # i32[B, R]; -1 = ineligible
+    bias: jax.Array,  # f32[R]
+    active: jax.Array,  # bool[B]
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Masked row argmax — Pallas form of ``route_pick_jnp`` (see the
+    section comment for why the two are bit-identical by argument)."""
+    B, R = match.shape
+    if B % 8 or R % 128:
+        raise ValueError(
+            f"route_pick_pallas needs B%8==0 and R%128==0, got B={B} "
+            f"R={R}; use accel='jnp' for unaligned route buckets"
+        )
+    # problem.py buckets are all multiples of 64; 64 is the one bucket
+    # below the 128 sublane tile (f32 min tile is (8, 128), so 64 rows
+    # are legal — just a shorter block).
+    tb = 128 if B % 128 == 0 else 64 if B % 64 == 0 else 8
+    tr = _tile_j(R)
+    row = pl.BlockSpec((1, tr), lambda b, r: (0, r), memory_space=pltpu.VMEM)
+    blk = pl.BlockSpec(
+        (tb, tr), lambda b, r: (b, r), memory_space=pltpu.VMEM
+    )
+    col = pl.BlockSpec((tb, 1), lambda b, r: (b, 0), memory_space=pltpu.VMEM)
+    val, idx = pl.pallas_call(
+        _route_pick_kernel,
+        grid=(B // tb, R // tr),
+        in_specs=[blk, row, col],
+        out_specs=[col, col],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(match, bias.reshape(1, R), active.astype(jnp.int32).reshape(B, 1))
+    return val[:, 0], idx[:, 0]
