@@ -6,8 +6,8 @@ PY ?= python
 # whatever JAX backend is live (real TPU chip if present).
 
 .PHONY: all native test test-fast test-chaos test-e2e bench bench-quick \
-        bench-full lint sanitize verify-flight trace-demo run-manager \
-        run-agent docker-build clean
+        bench-full lint sanitize verify-flight trace-demo envelope \
+        run-manager run-agent docker-build clean
 
 all: native lint test-fast
 
@@ -80,6 +80,15 @@ lint:
 # is belt-and-braces against this box's axon default.
 trace-demo:
 	JAX_PLATFORMS=cpu $(PY) -m kubeinfer_tpu.observability
+
+# Fleet-envelope smoke (envelope observatory PR): the tiny-preset
+# open-loop sweep + knee detection + joined-ledger pins, seconds on the
+# virtual CPU mesh. Same tests run in tier-1 via the auto-applied
+# observability marker; the O(1e5)-request full sweep is slow-marked
+# and excluded here.
+envelope:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability_envelope.py \
+		-q -m "not slow"
 
 # local quickstart helpers (see README)
 run-manager:

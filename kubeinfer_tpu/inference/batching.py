@@ -719,7 +719,9 @@ class ContinuousEngine:
                  spec_draft: tuple[Params, ModelConfig] | None = None,
                  spec_k: int = 4,
                  kv_dtype: str = "bf16",
-                 migration_chunk_blocks: int = 4) -> None:
+                 migration_chunk_blocks: int = 4,
+                 flight_capacity: int = 512,
+                 replica_name: str | None = None) -> None:
         # device layout (sharding.EngineLayout): tp=1 (the default) is
         # meshless and every placement below is the identity — the
         # engine is byte-for-byte the single-device engine. Under tp>1
@@ -860,7 +862,20 @@ class ContinuousEngine:
                               self._pool.free_blocks),
             name="batching.StepProfiler._lock",
         )
-        self.flight = FlightRecorder(name="batching.FlightRecorder._lock")
+        if flight_capacity < 1:
+            raise ValueError(
+                f"flight_capacity must be >= 1, got {flight_capacity}"
+            )
+        self.flight = FlightRecorder(
+            capacity=flight_capacity,
+            name="batching.FlightRecorder._lock",
+        )
+        # fleet identity on this engine's spans (engine.queue_wait /
+        # prefill / decode): every in-process replica records into the
+        # module-global RECORDER, so without a replica attr a merged
+        # fleet trace cannot say WHICH engine served a hop. None (the
+        # default) adds no attr — single-engine traces stay unchanged.
+        self.replica_name = replica_name
         # host copy of each slot's owned block ids (shared + fresh), in
         # table order — what retire returns to the pool
         self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
@@ -1378,6 +1393,18 @@ class ContinuousEngine:
             "migration_blocks": self.migration_blocks_total,
         }
 
+    def _span_ids(self, req: "_Request") -> dict:
+        """Fleet-join attrs carried by every engine span: the request
+        id under the flight ring's literal ``req`` key (so spans and
+        flight decisions correlate by the same id), plus the replica
+        name when this engine has one — fleetview groups a merged
+        trace's hops per replica by that attr. No replica_name = no
+        attr, so single-engine traces are unchanged."""
+        ids = {"req": req.rid}
+        if self.replica_name is not None:
+            ids["replica"] = self.replica_name
+        return ids
+
     def _note(self, kind: str, **detail) -> None:
         """Flight-recorder entry with queue depth + pool occupancy
         observed NOW. Callable from any thread: qsize and the pool
@@ -1697,6 +1724,7 @@ class ContinuousEngine:
             _TRACER.record_span(
                 "engine.queue_wait", start=req.t_submit, end=req.t_admit,
                 parent=req.trace_parent, slot=slot,
+                **self._span_ids(req),
             )
             if self._slo is not None:
                 self._slo.observe(
@@ -1967,6 +1995,7 @@ class ContinuousEngine:
             start=t0 if task.resumed else req.t_admit,
             slot=slot, prompt_tokens=p, bucket=T,
             reused_tokens=reuse * self.block_size, prefix_hit=reuse > 0,
+            **self._span_ids(req),
         )
         sp.event("first-token", ts=now)
         _TRACER.finish(sp, end=now)
@@ -2014,6 +2043,7 @@ class ContinuousEngine:
                 start=req.t_first or req.t_done, slot=slot,
                 tokens=len(req.out_tokens),
                 cancelled=req.cancelled.is_set(),
+                **self._span_ids(req),
                 # stamped whenever any token event below carries an
                 # interpolated timestamp (fused windows observe one
                 # bracket per K tokens, not one clock read per token) —

@@ -417,6 +417,13 @@ class InferenceServer:
             # deepest fingerprint — the target's chunked importer needs
             # no new endpoint
             continuous.migration_sink = self._export_migration_chunk
+            # fleet identity: engine spans inherit this server's
+            # model_id unless the engine was already named — model_id
+            # is the name the router registers the replica under, so
+            # fleetview's per-replica attribution lines up across the
+            # server and engine halves of one hop
+            if getattr(continuous, "replica_name", None) is None:
+                continuous.replica_name = model_id
         # last-seen monotonic kv_cache_stats counters, for the
         # delta-to-Counter conversion at scrape time; guarded because
         # ThreadingHTTPServer can run concurrent /metrics scrapes
@@ -534,7 +541,20 @@ class InferenceServer:
                         "export", time.perf_counter() - t0
                     )
                 elif path == "/debug/flightrecorder":
-                    fl = (server.continuous.flight.to_dict()
+                    # ?since= is an exactly-once cursor (events with
+                    # seq > since only), same contract as
+                    # StepProfiler.snapshot: a long-run drainer passes
+                    # its last-seen seq each poll instead of refetching
+                    # (and re-counting) the whole ring
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        since = int((q.get("since") or ["-1"])[0])
+                    except ValueError:
+                        self.respond(400, "application/json", json.dumps(
+                            {"error": "since must be an integer seq"}
+                        ))
+                        return
+                    fl = (server.continuous.flight.to_dict(since)
                           if server.continuous is not None
                           else {"capacity": 0, "recorded": 0,
                                 "events": []})
@@ -788,7 +808,11 @@ class InferenceServer:
         # matters)
         route_box = {"route": "invalid"}
         t0 = time.perf_counter()
-        with _TRACER.span("server.complete") as span:
+        # replica attr = model_id: every in-process server records into
+        # the shared RECORDER, and fleetview attributes a merged
+        # trace's hops to replicas by this attr (model_id is the name
+        # the router registers the replica under in the fleet benches)
+        with _TRACER.span("server.complete", replica=self.model_id) as span:
             try:
                 resp = self._complete(body, route_box)
             except ValueError:
@@ -884,9 +908,16 @@ class InferenceServer:
         if fps[-1] in advertised:
             return  # already warm locally (earlier import or admit)
         t0 = time.perf_counter()
-        imported, reason, wire_bytes = import_remote_prefix(
-            eng, ids, base_url,
-        )
+        # the ledger's "stream" phase: the span brackets the network
+        # fetch + verify + staged scatter, parented under the active
+        # server.complete span so it joins the request's trace
+        with _TRACER.span("server.kv_import", kind="prefix",
+                          replica=self.model_id) as sp:
+            imported, reason, wire_bytes = import_remote_prefix(
+                eng, ids, base_url,
+            )
+            sp.set(blocks=imported,
+                   **({"fallback": reason} if reason else {}))
         if imported > 0:
             self.metrics["kv_stream_blocks"].inc("import", by=imported)
             self.metrics["kv_stream_bytes"].inc("import", by=wire_bytes)
@@ -987,10 +1018,16 @@ class InferenceServer:
         if fps[-1] in advertised:
             return  # whole chain already warm (bounce-back resume)
         t0 = time.perf_counter()
-        imported, reason, wire_bytes = import_remote_chain(
-            eng, tokens, base_url,
-            chunk_blocks=getattr(eng, "migration_chunk_blocks", 4),
-        )
+        # same stream-phase span as _maybe_import_prefix: one name for
+        # both import shapes so ledger joins need a single rule
+        with _TRACER.span("server.kv_import", kind="chain",
+                          replica=self.model_id) as sp:
+            imported, reason, wire_bytes = import_remote_chain(
+                eng, tokens, base_url,
+                chunk_blocks=getattr(eng, "migration_chunk_blocks", 4),
+            )
+            sp.set(blocks=imported,
+                   **({"fallback": reason} if reason else {}))
         if imported > 0:
             self.metrics["kv_stream_blocks"].inc("import", by=imported)
             self.metrics["kv_stream_bytes"].inc("import", by=wire_bytes)
@@ -1389,6 +1426,18 @@ def main(argv: list[str] | None = None) -> int:
                    help="file holding the bearer token required on "
                         "/debug/* (spans, flight recorder, SLO); empty "
                         "leaves them open")
+    p.add_argument("--flight-capacity", type=int, default=512,
+                   help="flight-recorder ring size (scheduler "
+                        "decisions kept for /debug/flightrecorder); "
+                        "long load runs raise it so post-mortems and "
+                        "the ?since= cursor don't lose events between "
+                        "polls")
+    p.add_argument("--span-sample-every", type=int, default=1,
+                   help="record spans for 1 in N traces (head "
+                        "sampling, whole traces kept or dropped "
+                        "together; 1 = record all). Sampled-out "
+                        "requests still count in every metric — only "
+                        "span recording is gated")
     p.add_argument("--slo", action="append", default=[],
                    metavar="NAME:THRESHOLD_S:OBJECTIVE",
                    help="SLO objective, repeatable (e.g. ttft:0.5:0.99 "
@@ -1398,6 +1447,10 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     # lint: allow[log-discipline] main() is the process entrypoint and owns root logging config
     logging.basicConfig(level=logging.INFO)
+    if args.span_sample_every != 1:
+        # process-global on purpose: the keep/drop verdict must agree
+        # across every tracer in this process or ledgers shear mid-hop
+        tracing.set_span_sampling(args.span_sample_every)
 
     import jax
     import jax.numpy as jnp
@@ -1519,6 +1572,7 @@ def main(argv: list[str] | None = None) -> int:
             spec_k=args.speculation_depth,
             kv_dtype=args.kv_dtype,
             migration_chunk_blocks=args.migration_chunk_blocks,
+            flight_capacity=args.flight_capacity,
         )
         if args.prewarm_spec and speculative is not None:
             sizes = tuple(

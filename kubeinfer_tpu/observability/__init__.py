@@ -1,6 +1,9 @@
 """Request-scoped observability: tracing, trace-context propagation,
 Chrome trace export, step-level engine profiling, the scheduler flight
-recorder, and SLO burn-rate monitoring. See docs/OBSERVABILITY.md."""
+recorder, and SLO burn-rate monitoring — plus the fleet plane:
+trace-driven load generation (loadgen), cross-replica request ledgers
+and merged traces (fleetview), and envelope analytics. See
+docs/OBSERVABILITY.md."""
 
 from kubeinfer_tpu.observability.tracing import (
     RECORDER,
@@ -17,7 +20,10 @@ from kubeinfer_tpu.observability.tracing import (
     now,
     parse_traceparent,
     set_clock,
+    set_span_sampling,
+    span_sampling,
     to_chrome_trace,
+    trace_sampled,
 )
 
 __all__ = [
@@ -35,10 +41,15 @@ __all__ = [
     "now",
     "parse_traceparent",
     "set_clock",
+    "set_span_sampling",
+    "span_sampling",
     "to_chrome_trace",
+    "trace_sampled",
     # step profiler / flight recorder / SLO monitor are intentionally
     # NOT re-exported from the package root: tracing must stay an
     # import leaf (its docstring contract), and the engine/server
     # import the submodules directly — kubeinfer_tpu.observability
-    # .stepprof / .flightrecorder / .slo.
+    # .stepprof / .flightrecorder / .slo; same for the fleet plane
+    # (.loadgen / .fleetview), whose only consumers are bench and
+    # tests.
 ]

@@ -125,16 +125,23 @@ class FlightRecorder:
                 mon.observe(self, ev)
         return ev
 
-    def snapshot(self) -> list[FlightEvent]:
+    def snapshot(self, since_seq: int = -1) -> list[FlightEvent]:
+        """Events with ``seq > since_seq`` (all, by default) — the same
+        exactly-once cursor contract as ``StepProfiler.snapshot``, so a
+        long-run drainer (fleetview, ``/debug/flightrecorder?since=``)
+        replays each decision once even though the ring itself keeps
+        overwriting. Events already evicted by the ring before the
+        drainer came back are gone; ``recorded`` in :meth:`to_dict`
+        versus the cursor gap is how a reader detects that loss."""
         with self._lock:
-            return list(self._ring)
+            return [e for e in self._ring if e.seq > since_seq]
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
 
-    def to_dict(self) -> dict:
-        events = self.snapshot()
+    def to_dict(self, since_seq: int = -1) -> dict:
+        events = self.snapshot(since_seq)
         return {
             "capacity": self._ring.maxlen,
             "recorded": self._seq,

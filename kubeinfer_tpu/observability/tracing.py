@@ -61,7 +61,10 @@ __all__ = [
     "now",
     "parse_traceparent",
     "set_clock",
+    "set_span_sampling",
+    "span_sampling",
     "to_chrome_trace",
+    "trace_sampled",
 ]
 
 
@@ -138,6 +141,49 @@ def now() -> float:
     span start/end and the instrumented request timelines, so
     simulated-clock tests see a single coherent timeline."""
     return _default_clock.now()
+
+
+# --- head sampling ---------------------------------------------------------
+
+# Keep-1-in-N switch applied at the single record point
+# (:meth:`Tracer.finish`). The decision is a pure function of the trace
+# id, NOT a counter or RNG draw: every hop of a distributed request
+# (router, prefill replica, decode replica) hashes the same propagated
+# trace id to the same verdict, so a sampled-in trace keeps ALL its
+# spans and a sampled-out one keeps none — ledgers stay whole or absent,
+# never partial. Sampled-out requests still count in metrics: the
+# histograms and SLO monitors read the request timeline fields
+# (t_submit/t_admit/t_first/t_done), which this switch never touches.
+_sample_every: int = 1
+
+
+def set_span_sampling(n: int) -> int:
+    """Keep one trace in ``n`` (1 = keep everything, the default);
+    returns the previous setting so callers can restore it."""
+    global _sample_every
+    if n < 1:
+        raise ValueError(f"span sampling must be >= 1, got {n}")
+    prev = _sample_every
+    _sample_every = n
+    return prev
+
+
+def span_sampling() -> int:
+    return _sample_every
+
+
+def trace_sampled(trace_id: str, n: int | None = None) -> bool:
+    """Deterministic keep/drop verdict for a trace id. The low 32 bits
+    of the (uniformly random) id are as good a hash as any; a malformed
+    id is kept so a bad inbound header degrades to over-recording, not
+    a silent ledger hole."""
+    n = _sample_every if n is None else n
+    if n <= 1:
+        return True
+    try:
+        return int(trace_id[-8:], 16) % n == 0
+    except ValueError:
+        return True
 
 
 # --- spans -----------------------------------------------------------------
@@ -303,8 +349,13 @@ class Tracer:
         )
 
     def finish(self, span: Span, end: float | None = None) -> Span:
+        # THE single record point — both span() and record_span() land
+        # here, so the head-sampling gate lives here and nowhere else.
+        # The span is still ended and returned either way: callers that
+        # read timings/attrs off the return see no difference.
         span.end = self._now() if end is None else end
-        self._rec().record(span)
+        if trace_sampled(span.trace_id):
+            self._rec().record(span)
         return span
 
     def record_span(self, name: str, start: float, end: float,

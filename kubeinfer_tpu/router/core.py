@@ -217,8 +217,16 @@ class FleetRouter:
         breaker_reset_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         registry: Registry | None = None,
+        gamma: float = 0.0,
     ) -> None:
+        # gamma weights KV fullness (1 - headroom) in every scorer this
+        # router runs — per-request, python batch, and the solver's
+        # headroom plane alike, so the three engines stay in parity at
+        # any weight. Default 0 keeps routing byte-identical to the
+        # pre-headroom router (the plane was packed-but-unweighted
+        # since PR 18); RouterServer exposes it as --headroom-weight.
         self.alpha = alpha
+        self.gamma = gamma
         self.stale_after_s = stale_after_s
         self.dead_after_s = dead_after_s
         self._breaker_threshold = breaker_threshold
@@ -467,7 +475,8 @@ class FleetRouter:
             )
             pressure = scoring.queue_pressure(view.serving)
             score = scoring.replica_score(
-                match, pressure, stale, alpha=self.alpha
+                match, pressure, stale, alpha=self.alpha,
+                gamma=self.gamma, headroom=scoring.kv_headroom(view.serving),
             )
             n_scored += 1
             key = (score, view.name)
@@ -569,6 +578,10 @@ class FleetRouter:
             pressures = [0.0] * n_views
             slots = np.ones(n_views, np.float32)
             headroom = np.ones(n_views, np.float32)
+            # float64 twin of the f32 solver plane: the python engine
+            # and the host-side decision rebuild score in float64 (the
+            # same math as route()), so B=1 parity stays byte-exact
+            headroom_f64 = [1.0] * n_views
             name_col = {s[0]: r for r, s in enumerate(snap)}
             excl_counts = [0] * n_views
             for ex in excludes:
@@ -607,7 +620,8 @@ class FleetRouter:
                 pressures[r] = scoring.queue_pressure(serving)
                 slots[r] = float(serving.get("n_slots") or 1) \
                     if isinstance(serving, dict) else 1.0
-                headroom[r] = scoring.kv_headroom(serving)
+                headroom_f64[r] = scoring.kv_headroom(serving)
+                headroom[r] = headroom_f64[r]
             eligible = np.broadcast_to(col_ok, (nb, n_views)).copy()
             for b, ex in enumerate(excludes):
                 for nm in ex:
@@ -632,14 +646,16 @@ class FleetRouter:
                 )
                 picks = _routing.decode_routes(
                     _routing.solve_routes(
-                        rp, alpha=float(self.alpha), mode=mode,
+                        rp, alpha=float(self.alpha),
+                        gamma=float(self.gamma), mode=mode,
                         accel=accel,
                     ),
                     nb,
                 )
             elif engine == "python":
                 match, picks = self._batch_python_pick(
-                    token_batch, snap, eligible, col_stale, pressures
+                    token_batch, snap, eligible, col_stale, pressures,
+                    headroom_f64,
                 )
             else:
                 raise ValueError(f"unknown route engine {engine!r}")
@@ -659,7 +675,8 @@ class FleetRouter:
                 m = int(match[b, r])
                 stale = bool(col_stale[r])
                 score = scoring.replica_score(
-                    m, pressures[r], stale, alpha=self.alpha
+                    m, pressures[r], stale, alpha=self.alpha,
+                    gamma=self.gamma, headroom=headroom_f64[r],
                 )
                 fallback = m == 0
                 decisions.append(RouteDecision(
@@ -706,6 +723,7 @@ class FleetRouter:
         eligible: np.ndarray,
         col_stale: np.ndarray,
         pressures: list[float],
+        headrooms: list[float],
     ) -> tuple[np.ndarray, np.ndarray]:
         """The per-request scorer run over a shared snapshot: returns
         the (match plane, picks) pair the solver engine would — same
@@ -725,7 +743,8 @@ class FleetRouter:
                 m = scoring.match_depth(fps_by_bs[bs], fps) if bs else 0
                 match[b, r] = m
                 score = scoring.replica_score(
-                    m, pressures[r], bool(col_stale[r]), alpha=self.alpha
+                    m, pressures[r], bool(col_stale[r]), alpha=self.alpha,
+                    gamma=self.gamma, headroom=headrooms[r],
                 )
                 if best is None or score > best[0] or (
                     score == best[0] and name < best[1]

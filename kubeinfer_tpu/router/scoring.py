@@ -75,9 +75,10 @@ def kv_headroom(serving: dict | None) -> float:
     servingStats dict (``kv_blocks_free`` / ``kv_blocks_in_use``,
     advertised since the pool gauges went real). Missing stats read as
     full headroom — like queue_pressure, an empty signal must not repel
-    traffic. Feeds the route solve's optional gamma plane; the
-    per-request scorer below deliberately ignores it (byte-compatible
-    single-request behavior)."""
+    traffic. Feeds the route solve's gamma plane and, when the router is
+    constructed with ``gamma > 0`` (``--headroom-weight``), the
+    per-request scorer below; at the default gamma of 0 the scorer stays
+    byte-compatible with its pre-headroom behavior."""
     if not isinstance(serving, dict):
         return 1.0
     try:
@@ -104,11 +105,20 @@ def match_depth(prefix_fps: Sequence[int], advertised: frozenset | set) -> int:
 
 
 def replica_score(match_blocks: int, pressure: float, stale: bool,
-                  alpha: float = ALPHA_QUEUE_BLOCKS) -> float:
+                  alpha: float = ALPHA_QUEUE_BLOCKS,
+                  gamma: float = 0.0, headroom: float = 1.0) -> float:
     """The routing objective for one replica. With zero matches
     everywhere this degenerates to least-loaded — which is exactly the
-    documented fallback, not a separate code path."""
-    s = float(match_blocks) - alpha * pressure
+    documented fallback, not a separate code path.
+
+    ``gamma`` weights KV *fullness* (``1 - headroom``, so a full pool
+    repels and an empty one is free) in the same block units as the
+    other terms. The defaults (gamma=0, headroom=1) contribute exactly
+    ``- 0.0 * 0.0`` — float arithmetic with two literal zeros — so every
+    pre-gamma caller gets bit-identical scores; the term mirrors
+    solver/routing.py's ``- gamma * (1 - headroom)`` plane so the
+    Python and solver engines stay in parity at any weight."""
+    s = float(match_blocks) - alpha * pressure - gamma * (1.0 - headroom)
     if stale:
         s -= STALE_PENALTY_BLOCKS
     return s

@@ -662,6 +662,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--alpha", type=float,
                    default=None, help="queue-pressure weight in blocks "
                    "(default: scoring.ALPHA_QUEUE_BLOCKS)")
+    p.add_argument("--headroom-weight", type=float, default=0.0,
+                   help="KV-fullness weight in blocks: each replica's "
+                        "score drops by weight * (1 - free pool "
+                        "fraction), steering arrivals off "
+                        "eviction-pressured replicas (0 = off, "
+                        "byte-identical routing)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="seconds between /cache/summary refreshes")
     p.add_argument("--tokenizer", default=None, metavar="DIR",
@@ -684,6 +690,7 @@ def main(argv: list[str] | None = None) -> int:
     router = FleetRouter(
         alpha=args.alpha if args.alpha is not None
         else scoring.ALPHA_QUEUE_BLOCKS,
+        gamma=args.headroom_weight,
     )
     for spec in args.replica:
         name, _, url = spec.partition("=")

@@ -125,6 +125,68 @@ class TestSpanCore:
         assert [s.name for s in rec.snapshot()] == ["s6", "s7", "s8", "s9"]
 
 
+class TestHeadSampling:
+    """1-in-N head sampling satellite: the keep/drop decision is a pure
+    function of the trace id, taken once at the single record path, so
+    a trace's spans survive or vanish together across hops."""
+
+    def test_default_is_record_everything(self):
+        assert tracing.span_sampling() == 1
+        assert tracing.trace_sampled("ab" * 16)
+
+    def test_validation_and_restore(self):
+        prev = tracing.set_span_sampling(4)
+        try:
+            assert tracing.span_sampling() == 4
+            with pytest.raises(ValueError):
+                tracing.set_span_sampling(0)
+            assert tracing.span_sampling() == 4  # rejected, unchanged
+        finally:
+            tracing.set_span_sampling(prev)
+        assert tracing.span_sampling() == 1
+
+    def test_decision_is_pure_function_of_trace_id(self):
+        tid = "0123456789abcdef0123456789abcdef"
+        for n in (2, 5, 16):
+            want = int(tid[-8:], 16) % n == 0
+            assert tracing.trace_sampled(tid, n) == want
+        # malformed ids degrade to over-recording, never to loss
+        assert tracing.trace_sampled("not-hex-at-all!", 7)
+
+    def test_whole_trace_kept_or_dropped_together(self):
+        rec = SpanRecorder(name="test.Sampling.rec1")
+        tr = Tracer("t", recorder=rec)
+        prev = tracing.set_span_sampling(2)
+        try:
+            for _ in range(64):
+                with tr.span("root"):
+                    with tr.span("child"):
+                        pass
+        finally:
+            tracing.set_span_sampling(prev)
+        by_trace: dict[str, list] = {}
+        for s in rec.snapshot():
+            by_trace.setdefault(s.trace_id, []).append(s)
+        # every recorded trace is complete — root AND child — and at
+        # n=2 over 64 random ids both extremes are (2^-64) impossible
+        assert all(len(v) == 2 for v in by_trace.values())
+        assert 0 < len(by_trace) < 64
+
+    def test_finish_returns_span_even_when_dropped(self):
+        # callers read timings off the returned span (metrics path);
+        # sampling gates only the recorder write
+        rec = SpanRecorder(name="test.Sampling.rec2")
+        tr = Tracer("t", recorder=rec)
+        prev = tracing.set_span_sampling(1 << 30)
+        try:
+            with tr.span("likely-dropped") as sp:
+                pass
+        finally:
+            tracing.set_span_sampling(prev)
+        assert sp.end is not None
+        assert rec.snapshot() == [] or len(rec.snapshot()) <= 1
+
+
 class TestSimulatedClock:
     def test_per_tracer_clock_gives_deterministic_spans(self):
         clock = SimulatedClock(start=100.0)

@@ -160,6 +160,25 @@ class TestFlightRecorder:
         assert "queue=5" in lines[0] and "kv=30/32" in lines[0]
         assert "evict" in lines[1]
 
+    def test_snapshot_since_replays_exactly_once(self):
+        fr = FlightRecorder(name="test.Flight.l5")
+        for i in range(4):
+            fr.note("submit", queue_depth=i, t=float(i), req=i,
+                    prompt_tokens=1, max_new=1)
+        first = fr.snapshot()
+        cursor = first[-1].seq
+        assert fr.snapshot(cursor) == []
+        fr.note("evict", queue_depth=0, kv_in_use=1, kv_free=1, t=9.0,
+                nodes=1)
+        again = fr.snapshot(cursor)
+        assert [e.seq for e in again] == [cursor + 1]
+        # union of the two drains covers every event exactly once —
+        # the StepProfiler cursor contract, now shared by both rings
+        assert sorted(e.seq for e in first + again) == list(range(5))
+        d = fr.to_dict(cursor)
+        assert [e["seq"] for e in d["events"]] == [cursor + 1]
+        assert d["recorded"] == 5
+
     def test_counter_events_skip_unsampled_kv(self):
         fr = FlightRecorder(name="test.Flight.l4")
         fr.note("submit", queue_depth=1, t=1.0, req=0,
@@ -525,6 +544,36 @@ class TestServingMetrics:
         for e in doc["events"]:
             assert {"seq", "t", "kind", "queue_depth", "kv_in_use",
                     "kv_free", "detail"} <= set(e)
+
+    def test_debug_flightrecorder_since_cursor(self, serving):
+        _post_completion(serving, {"prompt": [5, 5], "max_tokens": 2})
+        _, body = _get(serving, "/debug/flightrecorder")
+        cursor = json.loads(body)["events"][-1]["seq"]
+        _, body = _get(serving, f"/debug/flightrecorder?since={cursor}")
+        assert json.loads(body)["events"] == []
+        _post_completion(serving, {"prompt": [5, 5, 5], "max_tokens": 2})
+        _, body = _get(serving, f"/debug/flightrecorder?since={cursor}")
+        fresh = json.loads(body)["events"]
+        assert fresh and all(e["seq"] > cursor for e in fresh)
+
+    def test_debug_flightrecorder_bad_since_is_400(self, serving):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(serving, "/debug/flightrecorder?since=nope")
+        assert ei.value.code == 400
+
+    def test_sampled_out_requests_still_count_in_metrics(self, serving):
+        # head sampling gates only the span RECORD path; the latency
+        # observations come from the request timeline, so a sampled-out
+        # request must still land in the SLO windows
+        prev = tracing.set_span_sampling(1 << 30)
+        try:
+            spans_before = len(tracing.RECORDER.snapshot())
+            ttft_before = len(serving.slo._obs["ttft"])
+            _post_completion(serving, {"prompt": [6, 6], "max_tokens": 2})
+            assert len(serving.slo._obs["ttft"]) == ttft_before + 1
+            assert len(tracing.RECORDER.snapshot()) == spans_before
+        finally:
+            tracing.set_span_sampling(prev)
 
     def test_debug_slo_endpoint(self, serving):
         _, body = _get(serving, "/debug/slo")

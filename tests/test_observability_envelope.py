@@ -1,0 +1,371 @@
+"""Fleet envelope observatory: cross-replica ledgers, knee analytics,
+and the tiny-preset envelope smoke (`make envelope`).
+
+Pins the acceptance criteria: a multi-hop request (router -> prefill ->
+decode, with a KV stream hop when migrated) joins into ONE ledger with
+a contiguous queue/route/prefill/stream/decode breakdown whose phases
+plus the explicit ``other`` residual sum to the end-to-end exactly; the
+knee is the highest offered load holding the TTFT SLO with bounded
+errors; and ``fleet_envelope_bench`` publishes the three knee scalars
+off a >=4-point sweep with curve + merged-trace side artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from types import SimpleNamespace
+
+import pytest
+
+from kubeinfer_tpu.observability import fleetview, loadgen, tracing
+from kubeinfer_tpu.observability.fleetview import (
+    EnvelopePoint,
+    RequestLedger,
+    build_ledgers,
+    detect_knee,
+    envelope_point,
+    tail_attribution,
+)
+from kubeinfer_tpu.observability.tracing import SpanRecorder, Tracer
+
+
+def synth_request(rec, t0=100.0, replica="r0", migrate_to=None):
+    """One synthetic request trace with exact, hand-picked phase
+    timestamps — queue 8ms, route 1ms, prefill 20ms, decode 60ms, and
+    (when migrating) a 5ms stream + 15ms resume prefill on the target."""
+    tr = {c: Tracer(c, recorder=rec)
+          for c in ("client", "router", "engine", "inference-server")}
+    root = tr["client"].start_span("client.request", start=t0)
+    ctx = root.context
+    tr["router"].record_span("router.route", start=t0 + 0.001,
+                             end=t0 + 0.002, parent=ctx, replica=replica)
+    tr["engine"].record_span("engine.queue_wait", start=t0 + 0.002,
+                             end=t0 + 0.010, parent=ctx, replica=replica)
+    tr["engine"].record_span("engine.prefill", start=t0 + 0.010,
+                             end=t0 + 0.030, parent=ctx, replica=replica)
+    if migrate_to is not None:
+        tr["inference-server"].record_span(
+            "server.kv_import", start=t0 + 0.030, end=t0 + 0.035,
+            parent=ctx, kind="chain", replica=migrate_to,
+        )
+        tr["engine"].record_span(
+            "engine.prefill", start=t0 + 0.035, end=t0 + 0.050,
+            parent=ctx, replica=migrate_to,
+        )
+        tr["engine"].record_span(
+            "engine.decode", start=t0 + 0.050, end=t0 + 0.090,
+            parent=ctx, replica=migrate_to,
+        )
+    else:
+        tr["engine"].record_span(
+            "engine.decode", start=t0 + 0.030, end=t0 + 0.090,
+            parent=ctx, replica=replica,
+        )
+    tr["client"].finish(root, end=t0 + 0.095)
+    return root.trace_id
+
+
+class TestLedgerJoin:
+    def test_single_hop_breakdown_pinned(self):
+        rec = SpanRecorder(name="test.Envelope.rec1")
+        tid = synth_request(rec)
+        (led,) = build_ledgers(rec.snapshot())
+        assert led.trace_id == tid
+        assert led.hops == 1
+        assert led.spans == 5  # root + route + queue + prefill + decode
+        assert led.phase_s["queue"] == pytest.approx(0.008)
+        assert led.phase_s["route"] == pytest.approx(0.001)
+        assert led.phase_s["prefill"] == pytest.approx(0.020)
+        assert led.phase_s["stream"] == 0.0
+        assert led.phase_s["decode"] == pytest.approx(0.060)
+        assert led.e2e_s == pytest.approx(0.095)
+        # contiguity: phases + explicit residual == e2e, exactly
+        assert sum(led.phase_s.values()) + led.other_s == \
+            pytest.approx(led.e2e_s)
+        assert led.other_s == pytest.approx(0.006)
+        assert led.dominant() == ("decode", "r0")
+
+    def test_migrated_request_joins_across_replicas(self):
+        rec = SpanRecorder(name="test.Envelope.rec2")
+        synth_request(rec, replica="p0", migrate_to="d1")
+        (led,) = build_ledgers(rec.snapshot())
+        assert led.hops == 2  # one engine.prefill per hop
+        # prefill time SUMS across hops; the replica path reads off in
+        # span start order: routed+prefilled on p0, resumed on d1
+        assert led.phase_s["prefill"] == pytest.approx(0.020 + 0.015)
+        assert led.phase_s["stream"] == pytest.approx(0.005)
+        assert led.phase_replicas["prefill"] == ["p0", "d1"]
+        assert led.phase_replicas["decode"] == ["d1"]
+        assert sum(led.phase_s.values()) + led.other_s == \
+            pytest.approx(led.e2e_s)
+
+    def test_trace_without_engine_span_is_not_a_request(self):
+        rec = SpanRecorder(name="test.Envelope.rec3")
+        tr = Tracer("router", recorder=rec)
+        root = tr.start_span("client.request", start=1.0)
+        tr.record_span("router.route", start=1.0, end=1.1,
+                       parent=root.context, replica="r0")
+        tr.finish(root, end=1.2)
+        assert build_ledgers(rec.snapshot()) == []
+
+    def test_no_root_span_falls_back_to_extent(self):
+        rec = SpanRecorder(name="test.Envelope.rec4")
+        tr = Tracer("engine", recorder=rec)
+        ctx = tracing.new_root_context()
+        tr.record_span("engine.prefill", start=2.0, end=2.5, parent=ctx,
+                       replica="r0")
+        tr.record_span("engine.decode", start=2.5, end=3.0, parent=ctx,
+                       replica="r0")
+        (led,) = build_ledgers(rec.snapshot())
+        assert led.t_start == 2.0 and led.t_end == 3.0
+        assert led.other_s == pytest.approx(0.0)
+
+    def test_ledgers_sorted_by_start(self):
+        rec = SpanRecorder(name="test.Envelope.rec5")
+        synth_request(rec, t0=200.0)
+        synth_request(rec, t0=100.0)
+        lo, hi = build_ledgers(rec.snapshot())
+        assert lo.t_start < hi.t_start
+
+
+class TestTailAttribution:
+    def _led(self, e2e, phase, replica="r0"):
+        phases = {ph: 0.0 for ph in fleetview.PHASES}
+        phases[phase] = e2e * 0.9
+        return RequestLedger(
+            trace_id="x", t_start=0.0, t_end=e2e, phase_s=phases,
+            other_s=e2e * 0.1, phase_replicas={phase: [replica]},
+            hops=1, spans=4,
+        )
+
+    def test_p99_cohort_names_phase_and_replica(self):
+        ledgers = [self._led(0.010, "decode") for _ in range(99)]
+        ledgers.append(self._led(1.0, "queue", replica="r1"))
+        out = tail_attribution(ledgers, q=99.0)
+        assert out["by_phase"] == {"queue": 1}
+        assert out["by_replica"] == {"r1": 1}
+        assert out["cohort"] == 1
+        assert out["e2e_s_cut"] == pytest.approx(1.0)
+
+    def test_empty_ledgers(self):
+        out = tail_attribution([])
+        assert out == {"cohort": 0, "by_phase": {}, "by_replica": {},
+                       "e2e_s_cut": None}
+
+
+class TestKneeDetection:
+    def _pt(self, offered, p99, errors=0, completed=100):
+        return EnvelopePoint(
+            offered_req_per_s=offered, completed=completed,
+            errors=errors, late_dispatches=0,
+            goodput_tokens_per_s=offered * 10, ttft_ms_p50=p99 / 2,
+            ttft_ms_p99=p99,
+        )
+
+    def test_knee_is_highest_load_holding_slo(self):
+        pts = [self._pt(5, 40), self._pt(10, 80), self._pt(20, 150),
+               self._pt(40, 900)]
+        knee = detect_knee(pts, slo_ttft_ms=200.0)
+        assert knee is not None and knee.offered_req_per_s == 20
+
+    def test_error_shedding_does_not_count_as_sustained(self):
+        # great p99 achieved by failing half the requests: not a knee
+        pts = [self._pt(5, 40), self._pt(50, 45, errors=50)]
+        knee = detect_knee(pts, slo_ttft_ms=200.0)
+        assert knee is not None and knee.offered_req_per_s == 5
+
+    def test_nan_p99_never_qualifies(self):
+        pts = [self._pt(5, float("nan"), completed=0)]
+        assert detect_knee(pts, slo_ttft_ms=200.0) is None
+
+    def test_all_points_over_slo_is_none(self):
+        pts = [self._pt(5, 500), self._pt(10, 900)]
+        assert detect_knee(pts, slo_ttft_ms=200.0) is None
+
+    def test_envelope_point_folds_empty_result_to_nan(self):
+        empty = SimpleNamespace(
+            completed=lambda: [], errors=lambda: 0, late_dispatches=0,
+            goodput_tokens_per_s=lambda: 0.0,
+            ttft_ms_percentile=lambda q: float("nan"),
+        )
+        pt = envelope_point(3.0, empty)
+        assert math.isnan(pt.ttft_ms_p99) and pt.completed == 0
+
+
+class _StubRing:
+    def __init__(self, recs):
+        self.recs = list(recs)
+
+    def snapshot(self, since_seq=-1):
+        return [r for r in self.recs if r.seq > since_seq]
+
+
+def _stub_engine(n_steps=3, n_flights=2):
+    steps = [SimpleNamespace(seq=i, t=float(i), live_rows=i % 4)
+             for i in range(n_steps)]
+    flights = [SimpleNamespace(seq=i, t=float(i), queue_depth=i,
+                               kv_in_use=4 + i, kv_free=4 - i)
+               for i in range(n_flights)]
+    return SimpleNamespace(profiler=_StubRing(steps),
+                           flight=_StubRing(flights))
+
+
+class TestFleetView:
+    def test_drain_is_exactly_once(self):
+        fv = fleetview.FleetView(recorder=SpanRecorder(
+            name="test.Envelope.rec6"))
+        eng = _stub_engine()
+        fv.register("r0", eng)
+        assert fv.drain() == {"r0": (3, 2)}
+        assert fv.drain() == {"r0": (0, 0)}
+        eng.profiler.recs.append(
+            SimpleNamespace(seq=3, t=3.0, live_rows=1))
+        assert fv.drain() == {"r0": (1, 0)}
+        assert len(fv.steps("r0")) == 4  # accumulated past the drains
+
+    def test_merged_trace_has_per_replica_pids_and_counters(self):
+        rec = SpanRecorder(name="test.Envelope.rec7")
+        synth_request(rec, replica="r0")
+        fv = fleetview.FleetView(recorder=rec)
+        fv.register("r0", _stub_engine())
+        fv.drain()
+        doc = fv.merged_chrome_trace()
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"}
+        # replica-tagged spans land in "replica:component" process
+        # groups; untagged (client) spans keep their component pid
+        assert {"r0:engine", "r0:router", "client",
+                "r0:counters"} <= names
+        counters = {e["name"] for e in doc["traceEvents"]
+                    if e["ph"] == "C"}
+        assert {"batch_occupancy", "queue_depth", "kv_blocks"} <= counters
+        # merged doc round-trips as JSON (it is a bench artifact)
+        json.dumps(doc)
+
+
+@pytest.fixture(scope="module")
+def envelope_run(tmp_path_factory):
+    """One tiny-preset envelope sweep shared by the smoke assertions —
+    the `make envelope` surface. Small on purpose: 4 points x 16
+    requests on a 2-replica tiny fleet, generous SLO so the knee is the
+    top point and the assertions stay deterministic."""
+    import bench
+
+    art = tmp_path_factory.mktemp("envelope")
+    curve_path = art / "bench_envelope.json"
+    trace_path = art / "bench_fleet_trace.json"
+    out = bench.fleet_envelope_bench(
+        n_replicas=2, model="tiny", seed=29,
+        rates=(2.0, 4.0, 8.0, 16.0), n_requests=16,
+        slo_ttft_ms=60_000.0, n_slots=2, cache_len=1024,
+        curve_path=str(curve_path), trace_path=str(trace_path),
+    )
+    return out, curve_path, trace_path
+
+
+class TestJoinedLedgerRealFleet:
+    def test_router_prefill_decode_is_one_contiguous_ledger(
+            self, envelope_run):
+        """The acceptance pin on REAL spans: one request driven through
+        the router joins into a single ledger whose engine phases abut
+        exactly (queue ends where prefill starts; prefill ends at the
+        first token where decode starts)."""
+        # envelope_run warmed every jit shape; this fleet serves in ms
+        import jax
+        import jax.numpy as jnp
+
+        from kubeinfer_tpu.inference import PRESETS, init_params
+        from kubeinfer_tpu.inference.batching import ContinuousEngine
+        from kubeinfer_tpu.inference.engine import Engine
+        from kubeinfer_tpu.inference.server import InferenceServer
+        from kubeinfer_tpu.router import FleetRouter, RouterServer
+
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0),
+                             dtype=jnp.bfloat16)
+        cont = ContinuousEngine(params, cfg, n_slots=2, cache_len=1024,
+                                block_size=32).start()
+        srv = InferenceServer(Engine(params, cfg), model_id="r0",
+                              port=0, continuous=cont).start()
+        router = FleetRouter()
+        router.add_replica("r0", f"http://127.0.0.1:{srv.port}")
+        rs = RouterServer(router)
+        try:
+            rs.poll_once()
+            tracing.RECORDER.clear()
+            tr = Tracer("client")
+            with tr.span("client.request") as sp:
+                code, _ = rs.forward(json.dumps(
+                    {"prompt": [3] * 12, "max_tokens": 3}).encode())
+            assert code == 200
+            tid = sp.trace_id
+        finally:
+            rs.stop()
+            srv.stop()
+            cont.stop()
+        spans = [s for s in tracing.RECORDER.snapshot()
+                 if s.trace_id == tid]
+        (led,) = [l for l in build_ledgers(spans) if l.trace_id == tid]
+        assert led.hops == 1
+        for ph in ("route", "prefill", "decode"):
+            assert led.phase_s[ph] > 0.0, ph
+        assert led.phase_replicas["prefill"] == ["r0"]
+        assert led.phase_replicas["decode"] == ["r0"]
+        assert sum(led.phase_s.values()) + led.other_s == \
+            pytest.approx(led.e2e_s)
+        by_name = {s.name: s for s in spans}
+        q = by_name["engine.queue_wait"]
+        pf = by_name["engine.prefill"]
+        dc = by_name["engine.decode"]
+        assert q.end == pytest.approx(pf.start, abs=1e-6)
+        assert pf.end == pytest.approx(dc.start, abs=1e-6)
+
+
+class TestEnvelopeSmoke:
+    def test_publishes_knee_scalars(self, envelope_run):
+        out, _, _ = envelope_run
+        assert out["envelope_points"] == 4
+        # SLO is generous and the tiny fleet absorbs every point, so
+        # the knee is the top of the sweep
+        assert out["fleet_knee_req_per_s"] > 0.0
+        assert out["goodput_tokens_per_sec_at_knee"] > 0.0
+        assert out["ttft_ms_p99_at_knee"] > 0.0
+        assert out["envelope_ledgers"] > 0
+        assert out["envelope_tail_phase"] in fleetview.PHASES + ("other",)
+        json.dumps(out)  # ONE-JSON-line contract: serializable as-is
+
+    def test_curve_artifact_is_a_four_point_sweep(self, envelope_run):
+        out, curve_path, _ = envelope_run
+        curve = json.loads(curve_path.read_text())
+        assert len(curve["points"]) == 4
+        offered = [p["offered_req_per_s"] for p in curve["points"]]
+        assert offered == sorted(offered)
+        for p in curve["points"]:
+            assert p["completed"] + p["errors"] == 16
+            assert len(p["schedule_checksum"]) == 64
+            assert p["ledgers"] > 0
+        assert curve["knee"] is not None
+        assert curve["knee"]["offered_req_per_s"] == \
+            pytest.approx(out["fleet_knee_req_per_s"], abs=1e-3)
+
+    def test_multihop_ledger_joined_from_real_fleet(self, envelope_run):
+        # acceptance pin on REAL spans: every point's ledgers joined
+        # router -> engine hops into contiguous breakdowns; check the
+        # curve's tail attribution came from engine phases
+        _, curve_path, _ = envelope_run
+        curve = json.loads(curve_path.read_text())
+        for p in curve["points"]:
+            assert set(p["tail"]["by_phase"]) <= \
+                set(fleetview.PHASES) | {"other"}
+            assert p["tail"]["cohort"] >= 1
+
+    def test_merged_trace_artifact_loads(self, envelope_run):
+        _, _, trace_path = envelope_run
+        doc = json.loads(trace_path.read_text())
+        evs = doc["traceEvents"]
+        names = {e["args"]["name"] for e in evs
+                 if e.get("name") == "process_name"}
+        assert any(n.endswith(":counters") for n in names)
+        assert any(n.startswith("r0:") or n.startswith("r1:")
+                   for n in names)

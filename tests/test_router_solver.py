@@ -251,6 +251,57 @@ class TestRouteBatchEquivalence:
             scoring.STALE_PENALTY_BLOCKS
 
 
+class TestHeadroomGamma:
+    """--headroom-weight satellite: the KV-fullness plane is inert at
+    the default gamma=0 (byte-compatible scores) and, when armed, steers
+    identical-cache picks toward the replica with free KV — with the
+    python scorer, the batched solver, and route() all agreeing."""
+
+    def plant(self, gamma=0.0):
+        clk = SimulatedClock(start=100.0)
+        r = FleetRouter(clock=clk.now, gamma=gamma)
+        toks = list(range(16))
+        # identical caches and queues; only KV fullness differs, so the
+        # gamma plane is the ONLY discriminator ("full" wins the
+        # name-order tie-break at gamma=0)
+        for name, free, used in [("full", 10, 90), ("roomy", 90, 10)]:
+            r.add_replica(name, f"http://{name}")
+            r.update_replica(name, serving(
+                summary=summary_of(toks),
+                kv_blocks_free=free, kv_blocks_in_use=used,
+            ))
+        return r, toks
+
+    def test_gamma_zero_is_byte_identical(self):
+        r0, toks = self.plant(gamma=0.0)
+        want = r0.route(toks)
+        assert want.replica == "full"  # tie -> lowest name
+        assert scoring.replica_score(3, 0.5, False) == \
+            scoring.replica_score(3, 0.5, False, gamma=0.0, headroom=0.1)
+
+    def test_gamma_steers_to_free_kv(self):
+        r, toks = self.plant(gamma=8.0)
+        got = r.route(toks)
+        assert got.replica == "roomy"
+        # score drop matches the documented plane: -gamma * (1 - headroom)
+        depth = scoring.match_depth(
+            prefix_fingerprints(toks, 4),
+            frozenset(summary_of(toks)["fingerprints"]),
+        )
+        assert got.score == pytest.approx(scoring.replica_score(
+            depth, 0.0, False, gamma=8.0, headroom=0.9,
+        ))
+
+    @pytest.mark.parametrize("gamma", [0.0, 2.5, 8.0])
+    def test_solver_python_and_single_agree(self, gamma):
+        r, toks = self.plant(gamma=gamma)
+        batch = [toks, toks[:8], [7] * 16, toks[:4]]
+        singles = [r.route(t) for t in batch]
+        for engine in ("python", "solver"):
+            assert r.route_batch(batch, engine=engine) == singles, \
+                (engine, gamma)
+
+
 class TestSpreadModes:
     def plant_identical(self, n=3, qd=0):
         r, _ = mk_router()
