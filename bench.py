@@ -1237,6 +1237,158 @@ def kv_quant_bench(short_new=8, long_new=72, prompt_len=32,
     return out
 
 
+def weight_quant_bench(short_new=8, long_new=72, prompt_len=32,
+                       n_slots=32, cache_len=256, cap_model="bench-1p7b",
+                       model="tiny", reps=3):
+    """Quantized-weights phase (int8 weights PR): capacity and
+    throughput of int8 per-tile weights against the bf16 weights they
+    replace.
+
+    Capacity is the headline and is computed at the serving-scale
+    preset (``cap_model``) via ``jax.eval_shape`` — the byte census
+    comes from the ACTUAL quantized template init_params builds (int8
+    codes + f32 scale planes + the bf16 leaves that deliberately stay
+    bf16: embeddings, norms, lm_head), not a 2x folklore number, and
+    eval_shape means no 1.7B-param allocation on the bench host.
+    ``max_model_params_at_1gib_w*`` divides 1 GiB by the measured
+    bytes-per-parameter; the ratio gate wants >= 1.7x, not 2.0x,
+    because scale planes and the bf16 tail are real bytes the figure
+    must charge for. Sized at 1.7B (not the 280M preset): the untied
+    lm_head+embedding pair is fixed bf16 overhead that shrinks
+    relative to the quantized projections as the model grows, and at
+    280M it would drag the ratio below the gate while misrepresenting
+    the deployment shape this phase models.
+
+    Throughput reuses the kv_quant_bench chain-differencing on
+    identical B=32 greedy workloads per weight dtype — on the CPU
+    fallback (quant_matmul_dense) this brackets the dequant-in-matmul
+    overhead rather than the HBM-bandwidth win the int8 weights buy on
+    silicon (PROFILING.md Round 20 defers that number to a TPU round).
+    ``weight_quant_max_abs_err`` round-trips the bf16 engine's OWN
+    projection leaves through quantize/dequantize — real init weights,
+    bounded by scale/2 per tile. The greedy match fraction understates
+    trained-model parity for the same reason as kv_quant_bench: random
+    weights put near-ties everywhere, and one flip diverges a row's
+    suffix — the per-position identity gate lives in
+    tests/test_weight_quant.py on exact-grid engine pairs."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeinfer_tpu.inference import PRESETS, init_params
+    from kubeinfer_tpu.inference.batching import ContinuousEngine
+    from kubeinfer_tpu.inference.weight_quant import (
+        QUANT_LEAVES, dequantize_weight, quantize_weight,
+    )
+
+    cfg = PRESETS[model]
+    # bf16 params so the baseline really is the bf16 deployment dtype
+    # (init_params defaults to f32 on CPU, which would halve the
+    # capacity story's baseline bytes and flatter nothing — but the
+    # throughput phases must hold the SAME weights so the greedy match
+    # fraction measures quantization, not init noise)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_slots)
+    ]
+    steps = n_slots * (long_new - short_new)
+    out = {}
+
+    # --- capacity at the serving-scale preset: eval_shape census ---
+    budget = float(1 << 30)
+    big = PRESETS[cap_model]
+    shapes = {
+        d: jax.eval_shape(
+            lambda d=d: init_params(
+                big, jax.random.PRNGKey(0), dtype=jnp.bfloat16,
+                weight_dtype=d,
+            )
+        )
+        for d in ("bf16", "int8")
+    }
+    # logical parameter count comes from the bf16 tree (the int8 tree
+    # carries extra scale leaves that are overhead bytes, not params)
+    n_params = sum(x.size for x in jax.tree.leaves(shapes["bf16"]))
+    for d in ("bf16", "int8"):
+        nbytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(shapes[d])
+        )
+        out[f"max_model_params_at_1gib_w{d}"] = int(
+            budget * n_params / nbytes
+        )
+    out["weight_quant_capacity_ratio"] = round(
+        out["max_model_params_at_1gib_wint8"]
+        / max(out["max_model_params_at_1gib_wbf16"], 1), 3
+    )
+
+    # --- quantization error on real init weights (bound: scale/2) ---
+    err = 0.0
+    for layer in params["layers"]:
+        for name in QUANT_LEAVES:
+            w = layer.get(name)
+            if w is None or not hasattr(w, "ndim") or w.ndim != 2:
+                continue
+            deq = dequantize_weight(quantize_weight(
+                jnp.asarray(w, jnp.float32)))
+            err = max(err, float(jnp.max(jnp.abs(  # lint: allow[host-sync] error readback before any engine starts; nothing timed yet
+                deq - jnp.asarray(w, jnp.float32)
+            ))))
+    out["weight_quant_max_abs_err"] = round(err, 6)
+
+    # --- throughput + parity on identical greedy workloads ---
+    def _phase(d):
+        eng = ContinuousEngine(
+            params, cfg, n_slots=n_slots, cache_len=cache_len,
+            block_size=16, weight_dtype=d,
+        ).start()
+        try:
+            def _run(max_new):
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts
+                ]
+                for r in reqs:
+                    if not r.done.wait(timeout=300):
+                        raise TimeoutError("weight-quant request hung")
+                return time.perf_counter() - t0, [
+                    list(r.out_tokens) for r in reqs
+                ]
+
+            _run(short_new)  # compile both shapes
+            _run(long_new)
+            _touch_progress()
+            shorts, longs = [], []
+            toks = None
+            for _ in range(reps):
+                shorts.append(_run(short_new)[0])
+                t, toks = _run(long_new)
+                longs.append(t)
+                _touch_progress()
+            dt = max(
+                statistics.median(longs) - statistics.median(shorts),
+                1e-9,
+            )
+        finally:
+            eng.stop()
+        return steps / dt, toks
+
+    tps_bf16, toks_bf16 = _phase("bf16")
+    tps_int8, toks_int8 = _phase("int8")
+    match = sum(
+        a == b for ta, tb in zip(toks_bf16, toks_int8)
+        for a, b in zip(ta, tb)
+    )
+    total = sum(len(t) for t in toks_bf16)
+    out.update({
+        "decode_tokens_per_sec_b32_wbf16": round(tps_bf16, 1),
+        "decode_tokens_per_sec_b32_wint8": round(tps_int8, 1),
+        "weight_quant_greedy_match_frac": round(match / max(total, 1), 4),
+    })
+    return out
+
+
 def _sharded_serving_child_main() -> int:
     """Child body of :func:`sharded_serving_bench` — runs in its OWN
     process because the jax device count is fixed at backend init: once
@@ -2909,6 +3061,27 @@ def main() -> None:
                 extras[key] = kq[key]
         except Exception as e:
             extras["kv_quant_error"] = f"{type(e).__name__}: {e}"
+        _ckpt_extras(extras)
+        # quantized-weights phase (int8 weights PR): eval_shape byte
+        # census at 1.7B -> params-per-GiB capacity (the >=1.7x gate,
+        # scale planes and the bf16 embed/lm_head tail charged), B=32
+        # decode throughput per weight dtype bracketing the
+        # dequant-in-matmul overhead on the CPU fallback, and the
+        # round-trip max-err / greedy-parity accuracy evidence
+        try:
+            wq = weight_quant_bench()
+            for key in (
+                "max_model_params_at_1gib_wbf16",
+                "max_model_params_at_1gib_wint8",
+                "weight_quant_capacity_ratio",
+                "decode_tokens_per_sec_b32_wbf16",
+                "decode_tokens_per_sec_b32_wint8",
+                "weight_quant_max_abs_err",
+                "weight_quant_greedy_match_frac",
+            ):
+                extras[key] = wq[key]
+        except Exception as e:
+            extras["weight_quant_error"] = f"{type(e).__name__}: {e}"
         _ckpt_extras(extras)
         # fleet-routing phase (prefix-cache-aware router PR): p50 TTFT
         # through the summary-scoring router vs cache-blind round-robin

@@ -66,6 +66,10 @@ from kubeinfer_tpu.observability.flightrecorder import FlightRecorder
 from kubeinfer_tpu.observability.slo import SLOMonitor, SLOObjective
 from kubeinfer_tpu.observability.stepprof import StepProfiler
 from kubeinfer_tpu.inference.sharding import EngineLayout
+from kubeinfer_tpu.inference.weight_quant import (
+    params_weight_dtype,
+    quantize_params,
+)
 from kubeinfer_tpu.inference.stepper import (
     DraftState,
     SlotState,
@@ -101,7 +105,9 @@ DEFAULT_BLOCK_SIZE = 128
 # engines never touch.
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "wq_gspmd"), donate_argnums=(1,)
+)
 def _admit_slot(
     params: Params,
     state: SlotState,
@@ -119,6 +125,7 @@ def _admit_slot(
     rep_penalty: jax.Array,  # f32[]
     key_data: jax.Array,  # u32[2] per-request PRNG key data
     seen_row: jax.Array,  # bool[1, V] host-computed full-prompt id set
+    wq_gspmd: bool = False,  # static: dense dequant route under GSPMD
 ) -> SlotState:
     """Prefill one request's novel suffix into the pool blocks of
     ``table_row`` (compiled per SUFFIX bucket — a warm admit of a long
@@ -181,7 +188,7 @@ def _admit_slot(
         ]
     logits, caches = forward(
         params, suffix, cfg, positions=q_pos[None, :], attn_mask=mask,
-        kv_caches=caches, cache_offset=start,
+        kv_caches=caches, cache_offset=start, wq_gspmd=wq_gspmd,
     )
 
     last = jnp.clip(suffix_len - 1, 0, T - 1)
@@ -272,7 +279,9 @@ def _admit_slot(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "wq_gspmd"), donate_argnums=(1,)
+)
 def _prefill_chunk(
     params: Params,
     state: SlotState,
@@ -281,6 +290,7 @@ def _prefill_chunk(
     cfg: ModelConfig,
     table_row: jax.Array,  # i32[max_blocks] this slot's block table
     own_mask: jax.Array,  # bool[max_blocks] True = freshly allocated block
+    wq_gspmd: bool = False,  # static: dense dequant route under GSPMD
 ) -> SlotState:
     """Commit ONE fixed-size prefill chunk's KV into the pool — no
     sampling, no slot-state installation (``_admit_slot`` finishes the
@@ -335,6 +345,7 @@ def _prefill_chunk(
     _, caches = forward(
         params, window, cfg, positions=q_pos[None, :], attn_mask=mask,
         kv_caches=caches, cache_offset=pos, return_hidden=True,
+        wq_gspmd=wq_gspmd,
     )
 
     own = own_mask[:, None, None, None]
@@ -515,6 +526,20 @@ class EngineDrainingError(RuntimeError):
     valid, THIS replica just won't take it — and the router can treat
     the refusal as 'mark draining, route elsewhere' rather than a
     client error to relay."""
+
+
+class EngineOverloadedError(RuntimeError):
+    """submit() shed because the waiting-work depth reached
+    ``queue_depth_limit`` (ROADMAP item 5's graceful load-shedding:
+    refuse at the door instead of queue collapse). Distinct from
+    EngineDrainingError because the remedy differs — a drained replica
+    never recovers for new work, an overloaded one does, so the server
+    answers 503 WITH Retry-After and the router treats it as transient
+    pressure, not evacuation."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass(frozen=True)
@@ -719,6 +744,8 @@ class ContinuousEngine:
                  spec_draft: tuple[Params, ModelConfig] | None = None,
                  spec_k: int = 4,
                  kv_dtype: str = "bf16",
+                 weight_dtype: str = "bf16",
+                 queue_depth_limit: int = 0,
                  migration_chunk_blocks: int = 4,
                  flight_capacity: int = 512,
                  replica_name: str | None = None) -> None:
@@ -731,7 +758,34 @@ class ContinuousEngine:
         self.layout = layout if layout is not None else EngineLayout()
         self.layout.check_model(cfg)
         self._sharded = self.layout.sharded
+        # weight precision axis (ISSUE 20), kv_dtype's load-time
+        # mirror: "int8" accepts either pre-quantized params (the
+        # load-time path — weights.params_from_state_dict /
+        # model.init_params, where the bf16 copy never reached the
+        # device) or plain params to quantize here; "bf16" with a
+        # quantized tree is a hard error rather than a silent
+        # dequantize, because the caller's capacity math would be wrong
+        if weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'bf16' or 'int8', got "
+                f"{weight_dtype!r}"
+            )
+        held = params_weight_dtype(params)
+        if weight_dtype == "int8" and held == "bf16":
+            params = quantize_params(params)
+        elif weight_dtype == "bf16" and held == "int8":
+            raise ValueError(
+                "weight_dtype='bf16' but params are weight-quantized "
+                "(dequantize_params first, or pass weight_dtype='int8')"
+            )
+        self.weight_dtype = weight_dtype
         self.params = self.layout.shard_params(params, cfg)
+        # static param footprint for the kubeinfer_model_param_bytes
+        # gauge: int8 pages + f32 scale planes under weight quant,
+        # global across the mesh (shape metadata only — no host sync)
+        self.model_param_bytes = int(sum(
+            x.nbytes for x in jax.tree.leaves(self.params)
+        ))
         self.cfg = cfg
         self.n_slots = n_slots
         self.cache_len = cache_len
@@ -986,6 +1040,10 @@ class ContinuousEngine:
                 *st.scales_v, *st.tails_k, *st.tails_v,
             )
         ))
+        # load-shedding door (ROADMAP item 5): 0 = unbounded (the
+        # pre-shedding behavior); > 0 sheds submits once waiting work
+        # (queue + holdover + parked) reaches the limit
+        self.queue_depth_limit = int(queue_depth_limit)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slot_req: list[_Request | None] = [None] * n_slots
         self._stop = threading.Event()
@@ -1058,6 +1116,36 @@ class ContinuousEngine:
                 raise ValueError(
                     f"resume bucket {_bucket(len(prompt) + len(rt))} "
                     f"exceeds slot capacity ({self.cache_len})"
+                )
+        if self.queue_depth_limit:
+            # same lockless depth read as stats_summary (torn by at
+            # most 1); >= so limit=1 means "shed whenever anything is
+            # already waiting"
+            depth = (self._queue.qsize() + len(self._holdover)
+                     + len(self._parked))
+            if depth >= self.queue_depth_limit:
+                # ledger the refusal as submit -> backpressure -> fail
+                # (the SPEC's queued self-loop, then the terminal) so
+                # flight post-mortems see WHY the request never reached
+                # a slot, then refuse with a retry hint instead of
+                # joining a queue already past the replica's drain rate
+                req = _Request(prompt, max_new_tokens, eos_id,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p, rep_penalty=repetition_penalty,
+                               seed=seed)
+                req.t_submit = tracing.now()
+                req.failed = "shed"
+                self._note("submit", req=req.rid,
+                           prompt_tokens=len(prompt),
+                           max_new=max_new_tokens)
+                self._note("backpressure", req=req.rid,
+                           reason="queue_depth_limit", depth=depth,
+                           limit=self.queue_depth_limit)
+                self._note("fail", req=req.rid, reason="shed")
+                req.done.set()
+                raise EngineOverloadedError(
+                    f"queue depth {depth} >= queue_depth_limit "
+                    f"{self.queue_depth_limit}"
                 )
         req = _Request(prompt, max_new_tokens, eos_id,
                        temperature=temperature, top_k=top_k, top_p=top_p,
@@ -1470,6 +1558,12 @@ class ContinuousEngine:
             "kv_blocks_in_use": kv["blocks_in_use"],
             "kv_dtype": self.kv_dtype,
             "kv_pool_bytes": kv["pool_bytes"],
+            # weight precision axis + resident param footprint: the
+            # capacity twin of the kv fields above, so fleet dashboards
+            # and the router can tell an int8-weights replica (≈2x
+            # model headroom) from a bf16 one on the same heartbeat
+            "weight_dtype": self.weight_dtype,
+            "model_param_bytes": self.model_param_bytes,
             "prefix_hit_rate": round(
                 kv["hits"] / lookups if lookups else 0.0, 6
             ),
@@ -1800,6 +1894,7 @@ class ContinuousEngine:
             self.params, self._state, jnp.asarray(window),
             jnp.int32(task.pos), self.cfg,
             jnp.asarray(task.table_row), jnp.asarray(task.own_mask),
+            wq_gspmd=self._sharded,
         )
         task.pos += C
         self.chunks_total += 1
@@ -1868,7 +1963,7 @@ class ContinuousEngine:
             jnp.asarray(task.table_row), jnp.asarray(task.own_mask),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
             jnp.float32(req.top_p), jnp.float32(req.rep_penalty), key_data,
-            jnp.asarray(seen_row),
+            jnp.asarray(seen_row), wq_gspmd=self._sharded,
         )
         if self.spec_draft is not None and task.spec_ok:
             # draft-row prefill rides the same boundary: the draft has

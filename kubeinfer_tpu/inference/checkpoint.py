@@ -32,11 +32,18 @@ def save_checkpoint(
     root.parent.mkdir(parents=True, exist_ok=True)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(root / "params", params)
+    from kubeinfer_tpu.inference.weight_quant import params_weight_dtype
+
     (root / "meta.json").write_text(json.dumps({
         "step": step,
         "config": dataclasses.asdict(cfg),
         "param_dtype": str(params["norm"].dtype),
         "tied": "lm_head" not in params,
+        # weight precision axis, recorded so restore rebuilds the
+        # quantized template (int8 codes + f32 scale planes) instead of
+        # re-quantizing — a double quantization would re-derive scales
+        # FROM int8 codes and silently corrupt the model
+        "weight_dtype": params_weight_dtype(params),
     }))
 
 
@@ -63,20 +70,31 @@ def restore_checkpoint(
             from jax.sharding import NamedSharding
 
             from kubeinfer_tpu.inference.model import init_params
-            from kubeinfer_tpu.inference.sharding import param_specs
+            from kubeinfer_tpu.inference.sharding import (
+                expand_quant_specs, param_specs,
+            )
 
             # abstract target tree: shapes from eval_shape (no
             # allocation), dtype from the save-time record, shardings
             # from the TP specs — orbax then reads each shard straight
-            # to its device
+            # to its device. A weight-quantized save rebuilds the
+            # quantized template the same way (eval_shape over
+            # init_params' weight_dtype axis — still zero allocation),
+            # so the restored tree is losslessly the saved one and the
+            # engine's double-quantize guard never trips.
             dtype = jnp.dtype(meta.get("param_dtype", "float32"))
+            wdt = meta.get("weight_dtype", "bf16")
             template: Any = jax.eval_shape(
-                lambda: init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+                lambda: init_params(
+                    cfg, jax.random.PRNGKey(0), dtype=dtype,
+                    weight_dtype=wdt,
+                )
             )
             specs = param_specs(cfg)
             if meta.get("tied", False):
                 specs = dict(specs)
                 specs.pop("lm_head", None)
+            specs = expand_quant_specs(specs, template)
             abstract = jax.tree.map(
                 lambda m, s: jax.ShapeDtypeStruct(
                     m.shape, m.dtype, sharding=NamedSharding(mesh, s)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeinfer_tpu.inference.config import ModelConfig
+from kubeinfer_tpu.inference.weight_quant import quantize_layer, wq_dot
 
 Params = dict[str, Any]
 
@@ -34,9 +35,19 @@ Params = dict[str, Any]
 
 
 def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype=jnp.float32
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.float32,
+    weight_dtype: str = "bf16",
 ) -> Params:
-    """Random init (normal, 0.02 std — HF default) with HF tree layout."""
+    """Random init (normal, 0.02 std — HF default) with HF tree layout.
+
+    ``weight_dtype="int8"`` quantizes each layer's projection leaves as
+    it is built (weight_quant.quantize_layer), mirroring the load-time
+    path in weights.params_from_state_dict — the full-precision layer
+    never outlives the loop iteration. "bf16" (the dtype axis name, not
+    a cast — ``dtype`` still controls precision) leaves the tree
+    byte-identical to the pre-quantization layout."""
+    if weight_dtype not in ("bf16", "int8"):
+        raise ValueError(f"weight_dtype must be bf16|int8: {weight_dtype!r}")
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
     def dense(k, shape):
@@ -77,6 +88,8 @@ def init_params(
             layer["q_bias"] = jnp.zeros((q_dim,), dtype)
             layer["k_bias"] = jnp.zeros((kv_dim,), dtype)
             layer["v_bias"] = jnp.zeros((kv_dim,), dtype)
+        if weight_dtype == "int8":
+            layer = quantize_layer(layer)
         layers.append(layer)
     params: Params = {
         "embed_tokens": dense(k_embed, (V, H)),
@@ -215,8 +228,16 @@ def decoder_layer(
     tp_axis: str | None = None,
     tp_size: int = 1,
     block_tables: jax.Array | None = None,  # i32[B, max_blocks] paged write
+    wq_gspmd: bool = False,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """One pre-norm block; returns (x, updated kv cache or None).
+
+    Projection matmuls route through weight_quant.wq_dot so a layer
+    whose leaves are quantized dicts rides the fused dequant-matmul;
+    plain leaves take the literal ``x @ w`` (identical trace to the
+    pre-quantization engine). ``wq_gspmd`` pins the dense dequant route
+    under GSPMD sharding — the same custom-call constraint as the
+    attention kernels.
 
     ``block_tables`` switches the cache write to the paged layout: the
     cache operands are then the POOL tensors [num_blocks, block_size,
@@ -246,7 +267,9 @@ def decoder_layer(
         x, layer["input_layernorm"], cfg.rms_norm_eps,
         offset=cfg.rmsnorm_offset,
     )
-    q, k, v = h @ layer["q_proj"], h @ layer["k_proj"], h @ layer["v_proj"]
+    q = wq_dot(h, layer["q_proj"], gspmd=wq_gspmd)
+    k = wq_dot(h, layer["k_proj"], gspmd=wq_gspmd)
+    v = wq_dot(h, layer["v_proj"], gspmd=wq_gspmd)
     if cfg.qkv_bias:  # Qwen2 family; o_proj stays bias-free
         q = q + layer["q_bias"]
         k = k + layer["k_bias"]
@@ -354,7 +377,9 @@ def decoder_layer(
         kv_cache = (ck, cv)
 
     attn = attn_fn(q, k, v, mask)
-    attn_out = attn.reshape(B, T, n_q * D) @ layer["o_proj"]
+    attn_out = wq_dot(
+        attn.reshape(B, T, n_q * D), layer["o_proj"], gspmd=wq_gspmd
+    )
     if tp_axis is not None:
         # row-parallel epilogue: each device contracted its own heads
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -377,8 +402,11 @@ def decoder_layer(
             m = jax.lax.psum(m, tp_axis)
         x = x + m
     else:
-        gate = _mlp_act(cfg)(h @ layer["gate_proj"])
-        mlp = (gate * (h @ layer["up_proj"])) @ layer["down_proj"]
+        gate = _mlp_act(cfg)(wq_dot(h, layer["gate_proj"], gspmd=wq_gspmd))
+        mlp = wq_dot(
+            gate * wq_dot(h, layer["up_proj"], gspmd=wq_gspmd),
+            layer["down_proj"], gspmd=wq_gspmd,
+        )
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
         x = x + mlp
@@ -405,6 +433,7 @@ def forward(
     tp_size: int = 1,
     return_hidden: bool = False,
     block_tables: jax.Array | None = None,  # i32[B, max_blocks] paged write
+    wq_gspmd: bool = False,
 ) -> tuple[jax.Array, list | None]:
     """Logits [B, T, V] (+ updated KV caches when provided).
 
@@ -476,6 +505,7 @@ def forward(
             layer, x, cos, sin, attn_mask, cfg,
             kv_cache=cache, cache_offset=cache_offset, attn_fn=attn_fn,
             tp_axis=tp_axis, tp_size=tp_size, block_tables=block_tables,
+            wq_gspmd=wq_gspmd,
         )
         if new_caches is not None:
             new_caches.append(cache)

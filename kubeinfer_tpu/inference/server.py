@@ -324,6 +324,20 @@ def _serving_metrics(registry: Registry):
             "+ bf16 tail buffers), summed across the mesh",
             registry=registry,
         ),
+        "model_param_bytes": Gauge(
+            "kubeinfer_model_param_bytes",
+            "Resident bytes of the model parameters (int8 pages + f32 "
+            "scale planes under weight_dtype=int8), summed across the "
+            "mesh",
+            registry=registry,
+        ),
+        "requests_shed": Counter(
+            "kubeinfer_requests_shed_total",
+            "Completion requests refused at the admission door, by "
+            "reason (queue_depth_limit = graceful load shedding; the "
+            "client got 503 + Retry-After, never a queue slot)",
+            labels=("reason",), registry=registry,
+        ),
         "kv_quant_blocks": Counter(
             "kubeinfer_kv_quant_blocks_total",
             "KV blocks quantized to int8 on commit (admit-time fills "
@@ -627,6 +641,23 @@ class InferenceServer:
                             {"error": {"message": str(e), "type": "invalid_request_error"}}
                         ))
                     except Exception as e:  # keep the serving thread alive
+                        if server._is_overload_error(e):
+                            # graceful load shedding: valid request, no
+                            # queue room — 503 with a Retry-After hint
+                            # so well-behaved clients back off instead
+                            # of hammering the door
+                            sp.set(status=503)
+                            self.respond(
+                                503, "application/json",
+                                json.dumps({"error": {
+                                    "message": str(e),
+                                    "type": "overloaded",
+                                }}),
+                                headers={"Retry-After": str(max(
+                                    1, int(getattr(
+                                        e, "retry_after_s", 1.0))))},
+                            )
+                            return
                         if server._is_draining_error(e):
                             # the request is valid; THIS replica just
                             # won't take it — 503 with a typed body so
@@ -700,6 +731,9 @@ class InferenceServer:
         self.metrics["kv_blocks_in_use"].set(stats["blocks_in_use"])
         self.metrics["kv_blocks_free"].set(stats["blocks_free"])
         self.metrics["kv_pool_bytes"].set(stats["pool_bytes"])
+        self.metrics["model_param_bytes"].set(
+            self.continuous.model_param_bytes
+        )
         layout = self.continuous.layout
         self.metrics["tp_degree"].set(layout.tp)
         self.metrics["mesh_devices"].set(layout.mesh_devices)
@@ -819,10 +853,14 @@ class InferenceServer:
                 self.metrics["requests"].inc(route_box["route"], "invalid")
                 raise
             except Exception as e:
-                self.metrics["requests"].inc(
-                    route_box["route"],
-                    "draining" if self._is_draining_error(e) else "error",
-                )
+                if self._is_overload_error(e):
+                    self.metrics["requests_shed"].inc("queue_depth_limit")
+                    outcome = "shed"
+                elif self._is_draining_error(e):
+                    outcome = "draining"
+                else:
+                    outcome = "error"
+                self.metrics["requests"].inc(route_box["route"], outcome)
                 raise
             finally:
                 span.set(route=route_box["route"])
@@ -937,6 +975,16 @@ class InferenceServer:
         from kubeinfer_tpu.inference.batching import EngineDrainingError
 
         return isinstance(e, EngineDrainingError)
+
+    def _is_overload_error(self, e: BaseException) -> bool:
+        """Shed-at-the-door twin of _is_draining_error (same lazy-typed
+        import rationale); distinct because the HTTP answer differs —
+        overload carries Retry-After, drain does not recover."""
+        if self.continuous is None:
+            return False
+        from kubeinfer_tpu.inference.batching import EngineOverloadedError
+
+        return isinstance(e, EngineOverloadedError)
 
     def _export_migration_chunk(self, chunk: dict) -> None:
         """Engine migration sink (scheduler thread, OFF the engine
@@ -1392,6 +1440,21 @@ def main(argv: list[str] | None = None) -> int:
                         "commit (per-block-per-head scales, dequant in "
                         "the attention kernel) for ~2x the resident "
                         "slots at equal HBM; disagg peers must match")
+    p.add_argument("--weight-dtype", default="bf16",
+                   choices=("bf16", "int8"),
+                   help="model weight precision: int8 quantizes the "
+                        "projection matmul weights at LOAD time "
+                        "(per-tile absmax scales, dequant fused into "
+                        "the matmul) for ~2x model capacity at equal "
+                        "HBM; embeddings, norms, and lm_head stay in "
+                        "--dtype. Composes with --tensor-parallel-size "
+                        "(scale planes shard with their weights)")
+    p.add_argument("--queue-depth-limit", type=int, default=0,
+                   help="shed completion submits with 503 + Retry-After "
+                        "once waiting work (queue + holdover + parked) "
+                        "reaches this depth, counted under "
+                        "kubeinfer_requests_shed_total (0 = unbounded "
+                        "queueing, the pre-shedding behavior)")
     p.add_argument("--preemption-slo", default="",
                    metavar="THRESHOLD_S[:BURN_LIMIT]",
                    help="park the youngest decoding row (KV cached to "
@@ -1471,12 +1534,22 @@ def main(argv: list[str] | None = None) -> int:
             log.info("--random-init: %r is not a preset; using 'tiny'",
                      args.model)
             cfg = PRESETS["tiny"]
-        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype,
+                             weight_dtype=args.weight_dtype)
     else:
         from kubeinfer_tpu.inference.weights import load_pretrained
 
-        params, cfg = load_pretrained(args.model, dtype=dtype)
+        params, cfg = load_pretrained(args.model, dtype=dtype,
+                                      weight_dtype=args.weight_dtype)
         tokenizer = _load_tokenizer(args.model)
+    if args.weight_dtype == "int8" and args.sequence_parallel_size > 1:
+        # the SP engine shard_maps with manual param_specs and has no
+        # quantized-leaf path; refusing beats silently serving a
+        # broken long-prompt route
+        raise SystemExit(
+            "--weight-dtype int8 does not compose with "
+            "--sequence-parallel-size > 1 yet"
+        )
     if args.max_model_len > 0:
         max_cache = args.max_model_len
     else:
@@ -1571,6 +1644,8 @@ def main(argv: list[str] | None = None) -> int:
             ),
             spec_k=args.speculation_depth,
             kv_dtype=args.kv_dtype,
+            weight_dtype=args.weight_dtype,
+            queue_depth_limit=args.queue_depth_limit,
             migration_chunk_blocks=args.migration_chunk_blocks,
             flight_capacity=args.flight_capacity,
         )

@@ -175,12 +175,31 @@ def param_specs(cfg: ModelConfig) -> Params:
     }
 
 
+def expand_quant_specs(specs: Params, params: Params) -> Params:
+    """Grow a param_specs tree to match weight-quantized leaves: where
+    ``params`` carries a quant dict, the weight's spec applies to the
+    int8 codes and the f32 scale plane shards along the weight's OUT
+    axis (per-column storage, so there is no tile/tp divisibility
+    coupling). Placement only — no new programs, same as the rest of
+    the TP layout. Uses tree.map's prefix rule: ``specs`` is a prefix
+    of ``params``, so a P leaf meets the whole quant subtree."""
+
+    def one(spec, leaf):
+        if isinstance(leaf, dict) and "qw" in leaf:
+            out_axis = spec[-1] if len(spec) else None
+            return {"qw": spec, "scale": P(out_axis)}
+        return spec
+
+    return jax.tree.map(one, specs, params)
+
+
 def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     """Place a param pytree onto the mesh per param_specs."""
     specs = param_specs(cfg)
     if "lm_head" not in params:
         specs = dict(specs)
         specs.pop("lm_head")
+    specs = expand_quant_specs(specs, params)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )

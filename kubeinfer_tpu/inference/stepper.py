@@ -287,6 +287,7 @@ def step_forward(
         cache_offset=offset,
         block_tables=block_tables,
         attn_fn=attn_fn,
+        wq_gspmd=sharded,
     )
     return logits[:, 0], kv_caches
 
@@ -664,6 +665,7 @@ def verify_window(
         positions=positions, attn_mask=mask,
         kv_caches=_zip_kv(state),
         cache_offset=o, block_tables=state.tables, attn_fn=attn_fn,
+        wq_gspmd=sharded,
     )
 
     # --- acceptance --------------------------------------------------------

@@ -19,6 +19,7 @@ import numpy as np
 
 from kubeinfer_tpu.inference.config import ModelConfig
 from kubeinfer_tpu.inference.model import Params
+from kubeinfer_tpu.inference.weight_quant import quantize_weight
 
 
 def _to_np(t) -> np.ndarray:
@@ -34,9 +35,18 @@ def _to_np(t) -> np.ndarray:
 
 
 def params_from_state_dict(
-    sd: Mapping[str, object], cfg: ModelConfig, dtype=jnp.bfloat16
+    sd: Mapping[str, object], cfg: ModelConfig, dtype=jnp.bfloat16,
+    weight_dtype: str = "bf16",
 ) -> Params:
-    """HF llama state dict (name -> tensor) -> model.py param pytree."""
+    """HF llama state dict (name -> tensor) -> model.py param pytree.
+
+    ``weight_dtype="int8"`` quantizes each projection as it is mapped
+    (weight_quant.quantize_weight on the host tensor), so the
+    full-precision [in, out] device copy of a quantized leaf never
+    exists — the largest device-resident transient is one layer's
+    quantization scratch, not the whole bf16 model."""
+    if weight_dtype not in ("bf16", "int8"):
+        raise ValueError(f"weight_dtype must be bf16|int8: {weight_dtype!r}")
 
     def get(name: str) -> np.ndarray:
         for key in (name, f"model.{name}"):
@@ -44,8 +54,11 @@ def params_from_state_dict(
                 return _to_np(sd[key])
         raise KeyError(f"checkpoint missing tensor {name!r}")
 
-    def linear(name: str) -> jnp.ndarray:
-        return jnp.asarray(get(name).T, dtype)  # [out,in] -> [in,out]
+    def linear(name: str, quant: bool = False):
+        w = get(name).T  # [out,in] -> [in,out]
+        if quant and weight_dtype == "int8":
+            return quantize_weight(jnp.asarray(w, jnp.float32))
+        return jnp.asarray(w, dtype)
 
     layers = []
     for i in range(cfg.num_hidden_layers):
@@ -57,10 +70,10 @@ def params_from_state_dict(
             "post_attention_layernorm": jnp.asarray(
                 get(f"{p}.post_attention_layernorm.weight"), dtype
             ),
-            "q_proj": linear(f"{p}.self_attn.q_proj.weight"),
-            "k_proj": linear(f"{p}.self_attn.k_proj.weight"),
-            "v_proj": linear(f"{p}.self_attn.v_proj.weight"),
-            "o_proj": linear(f"{p}.self_attn.o_proj.weight"),
+            "q_proj": linear(f"{p}.self_attn.q_proj.weight", quant=True),
+            "k_proj": linear(f"{p}.self_attn.k_proj.weight", quant=True),
+            "v_proj": linear(f"{p}.self_attn.v_proj.weight", quant=True),
+            "o_proj": linear(f"{p}.self_attn.o_proj.weight", quant=True),
         }
         if cfg.num_local_experts > 0:
             # Mixtral naming: block_sparse_moe.gate is the router,
@@ -81,9 +94,13 @@ def params_from_state_dict(
                 ),
             }
         else:
-            layer["gate_proj"] = linear(f"{p}.mlp.gate_proj.weight")
-            layer["up_proj"] = linear(f"{p}.mlp.up_proj.weight")
-            layer["down_proj"] = linear(f"{p}.mlp.down_proj.weight")
+            layer["gate_proj"] = linear(
+                f"{p}.mlp.gate_proj.weight", quant=True
+            )
+            layer["up_proj"] = linear(f"{p}.mlp.up_proj.weight", quant=True)
+            layer["down_proj"] = linear(
+                f"{p}.mlp.down_proj.weight", quant=True
+            )
         if cfg.qkv_bias:  # Qwen2 family
             layer["q_bias"] = jnp.asarray(
                 get(f"{p}.self_attn.q_proj.bias"), dtype
@@ -106,7 +123,7 @@ def params_from_state_dict(
 
 
 def load_pretrained(
-    model_dir: str, dtype=jnp.bfloat16
+    model_dir: str, dtype=jnp.bfloat16, weight_dtype: str = "bf16"
 ) -> tuple[Params, ModelConfig]:
     """Load (params, config) from an HF snapshot directory."""
     root = pathlib.Path(model_dir)
@@ -123,4 +140,4 @@ def load_pretrained(
         with safe_open(str(shard), framework="np") as f:
             for name in f.keys():
                 sd[name] = f.get_tensor(name)
-    return params_from_state_dict(sd, cfg, dtype), cfg
+    return params_from_state_dict(sd, cfg, dtype, weight_dtype), cfg

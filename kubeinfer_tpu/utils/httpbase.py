@@ -104,11 +104,17 @@ class BaseEndpointHandler(BaseHTTPRequestHandler):
         :func:`traceparent_header` on the client side."""
         return tracing.parse_traceparent(self.headers.get("traceparent"))
 
-    def respond(self, code: int, ctype: str, payload: bytes | str) -> None:
+    def respond(self, code: int, ctype: str, payload: bytes | str,
+                headers: dict[str, str] | None = None) -> None:
         data = payload.encode() if isinstance(payload, str) else payload
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        # extra response headers (e.g. Retry-After on a load-shed 503)
+        # go between the fixed pair and end_headers, where http.server
+        # requires them
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 

@@ -379,6 +379,7 @@ class TestEngineInstrumentation:
             "prefix_cached_tokens", "cache_summary",
             "tp_degree", "mesh_devices",
             "kv_dtype", "kv_pool_bytes",
+            "weight_dtype", "model_param_bytes",
             "draining", "slo_burn",
         }
         # idle engine, no SLO monitor, no drain in flight: both
@@ -390,6 +391,10 @@ class TestEngineInstrumentation:
         # config and must be nonzero (the /metrics gauge leans on this)
         assert s["kv_dtype"] == "bf16"
         assert s["kv_pool_bytes"] > 0
+        # weight-side twin of the pool pair: dtype string for fleet
+        # rollout dashboards, static param bytes for the gauge
+        assert s["weight_dtype"] == "bf16"
+        assert s["model_param_bytes"] > 0
         # unsharded engine: the layout gauges report the degenerate
         # single-device layout, not an absent one
         assert s["tp_degree"] == 1
